@@ -1,0 +1,4 @@
+# placeholder - full implementation follows
+class Dataset: pass
+class Booster: pass
+from .utils.log import LightGBMError

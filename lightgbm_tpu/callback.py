@@ -1,0 +1,5 @@
+class EarlyStopException(Exception): pass
+def print_evaluation(*a, **k): pass
+def record_evaluation(*a, **k): pass
+def reset_parameter(*a, **k): pass
+def early_stopping(*a, **k): pass

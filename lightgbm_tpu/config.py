@@ -1,0 +1,387 @@
+"""Config system: parameter structs, parsing, alias table.
+
+Reference: include/LightGBM/config.h:20-406, src/io/config.cpp:15-349.
+One flat Config object holds every parameter (the reference splits them
+into IO/Objective/Metric/Tree/Boosting/Network sub-structs; we keep the
+same names and defaults, flat, because the TPU build passes a single
+hashable config into jitted tree-build steps).
+"""
+
+from dataclasses import dataclass, field, fields
+
+from .utils.log import Log, check
+from .utils.random import Random
+
+# Alias table, reference config.h:316-406 (~70 entries).
+PARAMETER_ALIASES = {
+    "config": "config_file",
+    "nthread": "num_threads",
+    "random_seed": "seed",
+    "num_thread": "num_threads",
+    "boosting": "boosting_type",
+    "boost": "boosting_type",
+    "application": "objective",
+    "app": "objective",
+    "train_data": "data",
+    "train": "data",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "valid": "valid_data",
+    "test_data": "valid_data",
+    "test": "valid_data",
+    "is_sparse": "is_enable_sparse",
+    "tranining_metric": "is_training_metric",
+    "train_metric": "is_training_metric",
+    "ndcg_at": "ndcg_eval_at",
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "num_leaf": "num_leaves",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_rounds": "num_iterations",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "shrinkage_rate": "learning_rate",
+    "tree": "tree_learner",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "two_round_loading": "use_two_round_loading",
+    "two_round": "use_two_round_loading",
+    "mlist": "machine_list_file",
+    "is_save_binary": "is_save_binary_file",
+    "save_binary": "is_save_binary_file",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "verbosity": "verbose",
+    "header": "has_header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "query": "group_column",
+    "query_column": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_feature": "categorical_column",
+    "cat_column": "categorical_column",
+    "cat_feature": "categorical_column",
+    "predict_raw_score": "is_predict_raw_score",
+    "predict_leaf_index": "is_predict_leaf_index",
+    "raw_score": "is_predict_raw_score",
+    "leaf_index": "is_predict_leaf_index",
+    "min_split_gain": "min_gain_to_split",
+    "topk": "top_k",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "num_classes": "num_class",
+}
+
+
+def key_alias_transform(params: dict) -> dict:
+    """Normalize aliased keys; explicit canonical keys win (config.h:394-404)."""
+    out = dict(params)
+    for k, v in params.items():
+        canon = PARAMETER_ALIASES.get(k)
+        if canon is not None:
+            out.pop(k, None)
+            if canon not in params:
+                out[canon] = v
+    return out
+
+
+def str2map(parameters: str) -> dict:
+    """Parse 'k1=v1 k2=v2' strings (config.cpp Str2Map)."""
+    params = {}
+    for arg in parameters.replace("\t", " ").replace("\n", " ").replace("\r", " ").split(" "):
+        arg = arg.strip()
+        if not arg:
+            continue
+        kv = arg.split("=")
+        if len(kv) == 2:
+            key = kv[0].strip().strip('"').strip("'")
+            val = kv[1].strip().strip('"').strip("'")
+            if key:
+                params[key] = val
+        else:
+            Log.warning("Unknown parameter %s", arg)
+    return key_alias_transform(params)
+
+
+def _parse_bool(value) -> bool:
+    if isinstance(value, bool):
+        return value
+    v = str(value).lower()
+    if v in ("false", "-", "0"):
+        return False
+    if v in ("true", "+", "1"):
+        return True
+    Log.fatal('Parameter should be "true"/"+" or "false"/"-", got [%s]', value)
+
+
+@dataclass
+class Config:
+    """All parameters, reference defaults (config.h:91-226)."""
+
+    # --- overall (config.h:229-244) ---
+    task: str = "train"
+    seed: int = None  # fans out to sub-seeds when set (config.cpp:40-47)
+    num_threads: int = 0
+    boosting_type: str = "gbdt"
+    objective: str = "regression"
+    metric: tuple = ()
+    tree_learner: str = "serial"
+
+    # --- IO (config.h:91-133) ---
+    max_bin: int = 256
+    num_class: int = 1
+    data_random_seed: int = 1
+    data: str = ""
+    valid_data: tuple = ()
+    output_model: str = "LightGBM_model.txt"
+    output_result: str = "LightGBM_predict_result.txt"
+    input_model: str = ""
+    verbose: int = 1
+    num_iteration_predict: int = -1
+    is_pre_partition: bool = False
+    is_enable_sparse: bool = True
+    use_two_round_loading: bool = False
+    is_save_binary_file: bool = False
+    enable_load_from_binary_file: bool = True
+    bin_construct_sample_cnt: int = 50000
+    is_predict_leaf_index: bool = False
+    is_predict_raw_score: bool = False
+    has_header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_column: str = ""
+
+    # --- objective (config.h:136-151) ---
+    sigmoid: float = 1.0
+    label_gain: tuple = ()
+    max_position: int = 20
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+
+    # --- metric (config.h:154-162) ---
+    ndcg_eval_at: tuple = (1, 2, 3, 4, 5)
+
+    # --- tree (config.h:166-186) ---
+    min_data_in_leaf: int = 100
+    min_sum_hessian_in_leaf: float = 10.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    num_leaves: int = 127
+    feature_fraction_seed: int = 2
+    feature_fraction: float = 1.0
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    top_k: int = 20
+
+    # --- boosting (config.h:195-216) ---
+    metric_freq: int = 1
+    is_training_metric: bool = False
+    num_iterations: int = 10
+    learning_rate: float = 0.1
+    bagging_fraction: float = 1.0
+    bagging_seed: int = 3
+    bagging_freq: int = 0
+    early_stopping_round: int = 0
+    drop_rate: float = 0.01
+    drop_seed: int = 4
+
+    # --- network (config.h:219-226) ---
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_file: str = ""
+
+    # derived
+    is_parallel: bool = False
+    is_parallel_find_bin: bool = False
+
+    # TPU-specific knobs (no reference equivalent)
+    device_row_chunk: int = 16384  # rows per histogram-matmul chunk
+
+    @classmethod
+    def from_params(cls, params) -> "Config":
+        """Build a Config from a dict or 'k=v ...' string, applying aliases,
+        seed fan-out and conflict checks."""
+        if isinstance(params, str):
+            params = str2map(params)
+        else:
+            params = key_alias_transform({k: v for k, v in params.items() if v is not None})
+        cfg = cls()
+        type_map = {f.name: f.type for f in fields(cls)}
+        for key, value in params.items():
+            if key in ("config_file", "data", "valid_data", "metric", "label_gain",
+                       "ndcg_eval_at", "task", "objective", "boosting_type",
+                       "tree_learner", "seed"):
+                continue  # handled specially below
+            if key not in type_map:
+                Log.warning("Unknown parameter: %s", key)
+                continue
+            cur = getattr(cfg, key)
+            if isinstance(cur, bool):
+                setattr(cfg, key, _parse_bool(value))
+            elif isinstance(cur, int) or cur is None and key != "seed":
+                setattr(cfg, key, int(float(value)) if not isinstance(value, str) else int(float(value)))
+            elif isinstance(cur, float):
+                setattr(cfg, key, float(value))
+            else:
+                setattr(cfg, key, value if not isinstance(value, str) else value)
+
+        # seed fan-out (config.cpp:40-47)
+        if "seed" in params:
+            cfg.seed = int(params["seed"])
+            rand = Random(cfg.seed)
+            int_max = 2**31 - 1
+            cfg.data_random_seed = rand.next_int(0, int_max)
+            cfg.bagging_seed = rand.next_int(0, int_max)
+            cfg.drop_seed = rand.next_int(0, int_max)
+            cfg.feature_fraction_seed = rand.next_int(0, int_max)
+
+        # enum-ish fields
+        if "task" in params:
+            t = str(params["task"]).lower()
+            if t in ("train", "training"):
+                cfg.task = "train"
+            elif t in ("predict", "prediction", "test"):
+                cfg.task = "predict"
+            elif t == "refit":
+                cfg.task = "refit"
+            else:
+                Log.fatal("Unknown task type %s", t)
+        if "boosting_type" in params:
+            b = str(params["boosting_type"]).lower()
+            if b in ("gbdt", "gbrt"):
+                cfg.boosting_type = "gbdt"
+            elif b == "dart":
+                cfg.boosting_type = "dart"
+            else:
+                Log.fatal("Unknown boosting type %s", b)
+        if "objective" in params:
+            cfg.objective = str(params["objective"]).lower()
+        if "tree_learner" in params:
+            v = str(params["tree_learner"]).lower()
+            mapping = {"serial": "serial",
+                       "feature": "feature", "feature_parallel": "feature",
+                       "data": "data", "data_parallel": "data",
+                       "voting": "voting", "voting_parallel": "voting"}
+            if v not in mapping:
+                Log.fatal("Unknown tree learner type %s", v)
+            cfg.tree_learner = mapping[v]
+        if "metric" in params:
+            raw = params["metric"]
+            if isinstance(raw, str):
+                raw = raw.lower().split(",")
+            seen, mts = set(), []
+            for m in raw:
+                m = str(m).strip().lower()
+                if m and m not in seen:
+                    seen.add(m)
+                    mts.append(m)
+            cfg.metric = tuple(mts)
+        if "data" in params:
+            cfg.data = str(params["data"])
+        if "valid_data" in params:
+            raw = params["valid_data"]
+            cfg.valid_data = tuple(raw.split(",")) if isinstance(raw, str) else tuple(raw)
+        if "label_gain" in params:
+            raw = params["label_gain"]
+            cfg.label_gain = tuple(float(x) for x in
+                                   (raw.split(",") if isinstance(raw, str) else raw))
+        if "ndcg_eval_at" in params:
+            raw = params["ndcg_eval_at"]
+            ats = sorted(int(x) for x in (raw.split(",") if isinstance(raw, str) else raw))
+            check(all(a > 0 for a in ats), "ndcg_eval_at must be positive")
+            cfg.ndcg_eval_at = tuple(ats)
+
+        if not cfg.label_gain:
+            # label_gain = 2^i - 1 (config.cpp:237-243)
+            cfg.label_gain = tuple([0.0] + [float((1 << i) - 1) for i in range(1, 31)])
+
+        cfg.validate()
+        cfg.check_param_conflict()
+        Log.set_level_from_verbosity(cfg.verbose)
+        return cfg
+
+    def validate(self):
+        """CHECKs from config.cpp:275-330."""
+        check(self.max_bin > 0, "max_bin should be > 0")
+        check(self.min_sum_hessian_in_leaf > 1.0 or self.min_data_in_leaf > 0,
+              "need min_sum_hessian_in_leaf > 1.0 or min_data_in_leaf > 0")
+        check(self.lambda_l1 >= 0.0, "lambda_l1 should be >= 0")
+        check(self.lambda_l2 >= 0.0, "lambda_l2 should be >= 0")
+        check(self.min_gain_to_split >= 0.0, "min_gain_to_split should be >= 0")
+        check(self.num_leaves > 1, "num_leaves should be > 1")
+        check(0.0 < self.feature_fraction <= 1.0, "feature_fraction in (0, 1]")
+        check(self.max_depth > 1 or self.max_depth < 0, "max_depth should be > 1 or < 0")
+        check(self.num_iterations >= 0, "num_iterations should be >= 0")
+        check(self.bagging_freq >= 0, "bagging_freq should be >= 0")
+        check(0.0 < self.bagging_fraction <= 1.0, "bagging_fraction in (0, 1]")
+        check(self.learning_rate > 0.0, "learning_rate should be > 0")
+        check(self.early_stopping_round >= 0, "early_stopping_round should be >= 0")
+        check(0.0 <= self.drop_rate <= 1.0, "drop_rate in [0, 1]")
+        check(self.num_machines >= 1, "num_machines should be >= 1")
+        check(self.num_class >= 1, "num_class should be >= 1")
+        check(self.max_position > 0, "max_position should be > 0")
+
+    def check_param_conflict(self):
+        """Reference config.cpp:139-187."""
+        is_multiclass = self.objective == "multiclass"
+        if is_multiclass:
+            if self.num_class <= 1:
+                Log.fatal("Number of classes should be specified and greater than 1 for multiclass training")
+        elif self.task == "train" and self.num_class != 1:
+            Log.fatal("Number of classes must be 1 for non-multiclass training")
+        for mt in self.metric:
+            mt_multiclass = mt in ("multi_logloss", "multi_error")
+            if is_multiclass != mt_multiclass:
+                Log.fatal("Objective and metrics don't match")
+
+        if self.num_machines > 1:
+            self.is_parallel = True
+        else:
+            self.is_parallel = False
+            self.tree_learner = "serial"
+        if self.tree_learner == "serial":
+            self.is_parallel = False
+            self.num_machines = 1
+        if self.tree_learner in ("serial", "feature"):
+            self.is_parallel_find_bin = False
+        elif self.tree_learner == "data":
+            self.is_parallel_find_bin = True
+            if self.histogram_pool_size >= 0:
+                Log.warning("Histogram LRU queue was enabled (histogram_pool_size=%f). "
+                            "Will disable this to reduce communication costs", self.histogram_pool_size)
+                self.histogram_pool_size = -1
+
+
+def load_config_file(path: str) -> dict:
+    """Parse a `key = value` config file (application.cpp:62-98; '#' comments)."""
+    params = {}
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            kv = line.split("=", 1)
+            if len(kv) == 2:
+                params[kv[0].strip()] = kv[1].strip()
+    return key_alias_transform(params)

@@ -1,0 +1,202 @@
+"""BinMapper: value -> bin discretization.
+
+Reference: include/LightGBM/bin.h:52-170, src/io/bin.cpp:44-268.
+Numeric features: greedy equal-frequency bin bounds found on a value
+sample; categorical: count-sorted top-`max_bin` categories. The find-bin
+algorithm below reproduces the reference's semantics exactly (including
+the zero-count insertion and the big-count-value handling) because
+train/valid bin compatibility ("CheckAlign") and accuracy parity both
+hinge on identical bin boundaries.
+
+value_to_bin is vectorized (np.searchsorted) instead of the reference's
+per-value binary search (bin.h:353-375) — same result, one fused pass.
+"""
+
+import numpy as np
+
+from ..utils.log import Log
+
+NUMERICAL = 0
+CATEGORICAL = 1
+
+_ZERO = 1e-10
+
+
+class BinMapper:
+    def __init__(self):
+        self.num_bin = 1
+        self.is_trivial = True
+        self.sparse_rate = 0.0
+        self.bin_type = NUMERICAL
+        self.bin_upper_bound = np.asarray([np.inf])
+        self.bin_2_categorical = np.zeros(0, dtype=np.int64)
+        self._cat_lookup = None
+
+    # ------------------------------------------------------------------ find
+    def find_bin(self, sample_values, total_sample_cnt, max_bin, bin_type=NUMERICAL):
+        """Find bin bounds from sampled non-zero values (bin.cpp:44-196).
+
+        sample_values: the non-zero sampled values of this feature;
+        total_sample_cnt: total rows sampled (zeros implied by the gap).
+        """
+        self.bin_type = bin_type
+        values = np.sort(np.asarray(sample_values, dtype=np.float64))
+        zero_cnt = int(total_sample_cnt - len(values))
+
+        # build (distinct_values, counts) with the zero block inserted in order
+        distinct_values, counts = [], []
+        if len(values) == 0 or (values[0] > 0.0 and zero_cnt > 0):
+            distinct_values.append(0.0)
+            counts.append(zero_cnt)
+        if len(values) > 0:
+            uniq, cnt = np.unique(values, return_counts=True)
+            for i, (v, c) in enumerate(zip(uniq.tolist(), cnt.tolist())):
+                if i > 0 and uniq[i - 1] < 0.0 and v > 0.0:
+                    distinct_values.append(0.0)
+                    counts.append(zero_cnt)
+                distinct_values.append(v)
+                counts.append(int(c))
+                if v == 0.0:
+                    counts[-1] += zero_cnt
+            if uniq[-1] < 0.0 and zero_cnt > 0:
+                distinct_values.append(0.0)
+                counts.append(zero_cnt)
+
+        num_values = len(distinct_values)
+        sample_size = float(total_sample_cnt)
+        cnt_in_bin0 = 0
+
+        if bin_type == NUMERICAL:
+            if num_values <= max_bin:
+                self.num_bin = max(num_values, 1)
+                if num_values == 0:
+                    self.bin_upper_bound = np.asarray([np.inf])
+                else:
+                    ub = np.empty(num_values)
+                    dv = np.asarray(distinct_values)
+                    ub[:-1] = (dv[:-1] + dv[1:]) / 2.0
+                    ub[-1] = np.inf
+                    self.bin_upper_bound = ub
+                    cnt_in_bin0 = counts[0]
+            else:
+                ub, cnt_in_bin0 = _greedy_bounds(
+                    np.asarray(distinct_values), np.asarray(counts, dtype=np.int64),
+                    sample_size, max_bin)
+                self.bin_upper_bound = ub
+                self.num_bin = len(ub)
+        else:
+            dv_int = []
+            cnt_int = []
+            for v, c in zip(distinct_values, counts):
+                iv = int(v)
+                if dv_int and iv == dv_int[-1]:
+                    cnt_int[-1] += c
+                else:
+                    dv_int.append(iv)
+                    cnt_int.append(c)
+            order = np.argsort(-np.asarray(cnt_int), kind="stable")
+            self.num_bin = min(max_bin, len(dv_int))
+            self.bin_2_categorical = np.asarray(
+                [dv_int[i] for i in order[:self.num_bin]], dtype=np.int64)
+            self._cat_lookup = None
+            used_cnt = int(sum(cnt_int[i] for i in order[:self.num_bin]))
+            if sample_size > 0 and used_cnt / sample_size < 0.95:
+                Log.warning("Too many categoricals are ignored, please use bigger "
+                            "max_bin or partition this column")
+            cnt_in_bin0 = int(sample_size) - used_cnt + (cnt_int[order[0]] if dv_int else 0)
+
+        self.is_trivial = self.num_bin <= 1
+        self.sparse_rate = (cnt_in_bin0 / sample_size) if sample_size > 0 else 0.0
+        return self
+
+    # ------------------------------------------------------------- transform
+    def value_to_bin(self, values):
+        """Vectorized value->bin (bin.h:353-375). Returns int32 bins."""
+        values = np.asarray(values)
+        if self.bin_type == NUMERICAL:
+            v = np.nan_to_num(values.astype(np.float64), nan=0.0)
+            return np.searchsorted(self.bin_upper_bound, v, side="left").astype(np.int32)
+        if self._cat_lookup is None:
+            self._cat_lookup = {int(c): i for i, c in enumerate(self.bin_2_categorical)}
+        look = self._cat_lookup
+        flat = values.reshape(-1)
+        out = np.fromiter((look.get(int(v), 0) for v in flat), dtype=np.int32,
+                          count=len(flat))
+        return out.reshape(values.shape)
+
+    def bin_to_value(self, bin_idx):
+        """Representative real value of a bin, used as the tree's stored
+        threshold (Feature::BinToValue)."""
+        if self.bin_type == NUMERICAL:
+            return float(self.bin_upper_bound[int(bin_idx)])
+        return float(self.bin_2_categorical[int(bin_idx)])
+
+    # --------------------------------------------------------- serialization
+    def to_dict(self):
+        return {
+            "num_bin": int(self.num_bin),
+            "is_trivial": bool(self.is_trivial),
+            "sparse_rate": float(self.sparse_rate),
+            "bin_type": int(self.bin_type),
+            "bin_upper_bound": np.asarray(self.bin_upper_bound, dtype=np.float64),
+            "bin_2_categorical": np.asarray(self.bin_2_categorical, dtype=np.int64),
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        m = cls()
+        m.num_bin = int(d["num_bin"])
+        m.is_trivial = bool(d["is_trivial"])
+        m.sparse_rate = float(d["sparse_rate"])
+        m.bin_type = int(d["bin_type"])
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = np.asarray(d["bin_2_categorical"], dtype=np.int64)
+        return m
+
+    def __eq__(self, other):
+        if self.num_bin != other.num_bin or self.bin_type != other.bin_type:
+            return False
+        if self.bin_type == NUMERICAL:
+            return np.array_equal(self.bin_upper_bound, other.bin_upper_bound)
+        return np.array_equal(self.bin_2_categorical, other.bin_2_categorical)
+
+
+def _greedy_bounds(distinct_values, counts, sample_size, max_bin):
+    """Greedy equal-frequency bound finding (bin.cpp:100-153)."""
+    num_values = len(distinct_values)
+    mean_bin_size = sample_size / max_bin
+    rest_bin_cnt = max_bin
+    rest_sample_cnt = int(sample_size)
+    is_big = counts >= mean_bin_size
+    rest_bin_cnt -= int(np.sum(is_big))
+    rest_sample_cnt -= int(np.sum(counts[is_big]))
+    mean_bin_size = rest_sample_cnt / rest_bin_cnt if rest_bin_cnt > 0 else np.inf
+
+    upper_bounds = np.full(max_bin, np.inf)
+    lower_bounds = np.full(max_bin, np.inf)
+    bin_cnt = 0
+    lower_bounds[0] = distinct_values[0]
+    cur_cnt_inbin = 0
+    cnt_in_bin0 = 0
+    for i in range(num_values - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= counts[i]
+        cur_cnt_inbin += counts[i]
+        if (is_big[i] or cur_cnt_inbin >= mean_bin_size or
+                (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))):
+            upper_bounds[bin_cnt] = distinct_values[i]
+            if bin_cnt == 0:
+                cnt_in_bin0 = cur_cnt_inbin
+            bin_cnt += 1
+            lower_bounds[bin_cnt] = distinct_values[i + 1]
+            if bin_cnt >= max_bin - 1:
+                break
+            cur_cnt_inbin = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / rest_bin_cnt if rest_bin_cnt > 0 else np.inf
+    bin_cnt += 1
+    ub = np.empty(bin_cnt)
+    ub[:-1] = (upper_bounds[:bin_cnt - 1] + lower_bounds[1:bin_cnt]) / 2.0
+    ub[-1] = np.inf
+    return ub, int(cnt_in_bin0)

@@ -1,0 +1,326 @@
+"""Binned dataset container + loader.
+
+Reference: include/LightGBM/dataset.h:278-421, src/io/dataset.cpp,
+include/LightGBM/dataset_loader.h, src/io/dataset_loader.cpp:162-941.
+
+TPU-first design: the training data is stored as ONE dense features-major
+integer matrix `bins` of shape (num_used_features, num_data) — uint8 when
+every feature has <= 256 bins, else uint16 — pushed to device once and
+read by every histogram kernel. The reference's per-feature Bin objects
+(dense/sparse/ordered variants, src/io/dense_bin.hpp / sparse_bin.hpp /
+ordered_sparse_bin.hpp) are CPU-cache layouts; on TPU one dense matrix
+feeds the MXU directly, and sparse features simply bin mostly to 0
+(`is_enable_sparse` is accepted and recorded per feature via sparse_rate,
+but storage is always dense in this build).
+
+The binary dataset cache (reference dataset.cpp:133-212 with a magic
+token) is an .npz with the same role: skip text parsing + binning on
+reload; auto-detected next to the data file.
+"""
+
+import os
+
+import numpy as np
+
+from ..utils.log import Log
+from ..utils.random import Random
+from .bin_mapper import BinMapper, NUMERICAL, CATEGORICAL
+from .metadata import Metadata
+from .parser import parse_text_file, ZERO_THRESHOLD
+
+BINARY_MAGIC = "lightgbm_tpu_dataset_v1"
+
+
+class CoreDataset:
+    """Eagerly-binned dataset (the reference's `Dataset`, dataset.h:278-421)."""
+
+    def __init__(self):
+        self.bins = None              # (F_used, N) uint8/uint16, host
+        self.bin_mappers = []         # per used feature
+        self.used_feature_map = None  # (num_total_features,) int32: total->used or -1
+        self.real_feature_idx = None  # (F_used,) int32: used -> total
+        self.feature_names = []       # one per total feature
+        self.num_total_features = 0
+        self.label_idx = 0
+        self.metadata = Metadata()
+        self._device_bins = None
+        self.raw_data = None          # optional (N, C) float32 original values
+
+    # ------------------------------------------------------------ properties
+    @property
+    def num_data(self):
+        return 0 if self.bins is None else self.bins.shape[1]
+
+    @property
+    def num_features(self):
+        return len(self.bin_mappers)
+
+    @property
+    def max_num_bin(self):
+        return max((m.num_bin for m in self.bin_mappers), default=1)
+
+    def num_bin_array(self):
+        return np.asarray([m.num_bin for m in self.bin_mappers], dtype=np.int32)
+
+    def feature_is_categorical(self):
+        return np.asarray([m.bin_type == CATEGORICAL for m in self.bin_mappers])
+
+    def device_bins(self):
+        """The (F, N) bin matrix on the default device (cached)."""
+        import jax.numpy as jnp
+        if self._device_bins is None:
+            self._device_bins = jnp.asarray(self.bins)
+        return self._device_bins
+
+    # ------------------------------------------------------------- alignment
+    def check_align(self, other: "CoreDataset") -> bool:
+        """Bin-mapper compatibility between train/valid (dataset.h CheckAlign)."""
+        if self.num_features != other.num_features:
+            return False
+        if self.num_total_features != other.num_total_features:
+            return False
+        return all(a == b for a, b in zip(self.bin_mappers, other.bin_mappers))
+
+    # ---------------------------------------------------------------- subset
+    def subset(self, indices) -> "CoreDataset":
+        """Row subset sharing bin mappers (dataset.cpp Subset; used by cv)."""
+        indices = np.asarray(indices, dtype=np.int64)
+        out = CoreDataset()
+        out.bins = np.ascontiguousarray(self.bins[:, indices])
+        out.bin_mappers = self.bin_mappers
+        out.used_feature_map = self.used_feature_map
+        out.real_feature_idx = self.real_feature_idx
+        out.feature_names = self.feature_names
+        out.num_total_features = self.num_total_features
+        out.label_idx = self.label_idx
+        out.metadata = self.metadata.subset(indices)
+        if self.raw_data is not None:
+            out.raw_data = self.raw_data[indices]
+        return out
+
+    # --------------------------------------------------------- binary cache
+    def save_binary(self, path):
+        """Binary cache (reference dataset.cpp:133-212)."""
+        arrays = {
+            "bins": self.bins,
+            "used_feature_map": self.used_feature_map,
+            "real_feature_idx": self.real_feature_idx,
+            "num_total_features": np.asarray(self.num_total_features),
+            "label_idx": np.asarray(self.label_idx),
+            "feature_names": np.asarray(self.feature_names, dtype=object),
+        }
+        for i, m in enumerate(self.bin_mappers):
+            for k, v in m.to_dict().items():
+                arrays[f"mapper{i}_{k}"] = np.asarray(v)
+        for k, v in self.metadata.to_dict().items():
+            arrays[f"meta_{k}"] = np.asarray(v)
+        np.savez_compressed(path, magic=np.asarray(BINARY_MAGIC), **arrays)
+        Log.info("Saved binary dataset to %s", str(path))
+
+    @classmethod
+    def load_binary(cls, path) -> "CoreDataset":
+        z = np.load(path, allow_pickle=True)
+        if str(z["magic"]) != BINARY_MAGIC:
+            Log.fatal("Binary file %s is not a lightgbm_tpu dataset", str(path))
+        ds = cls()
+        ds.bins = z["bins"]
+        ds.used_feature_map = z["used_feature_map"]
+        ds.real_feature_idx = z["real_feature_idx"]
+        ds.num_total_features = int(z["num_total_features"])
+        ds.label_idx = int(z["label_idx"])
+        ds.feature_names = [str(x) for x in z["feature_names"]]
+        n_used = len(ds.real_feature_idx)
+        ds.bin_mappers = []
+        for i in range(n_used):
+            d = {k[len(f"mapper{i}_"):]: z[k] for k in z.files
+                 if k.startswith(f"mapper{i}_")}
+            ds.bin_mappers.append(BinMapper.from_dict(d))
+        meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
+        ds.metadata = Metadata.from_dict(meta)
+        return ds
+
+
+class DatasetLoader:
+    """Text/matrix -> CoreDataset pipeline (dataset_loader.cpp:162-941)."""
+
+    def __init__(self, config=None, predict_fun=None):
+        from ..config import Config
+        self.config = config if config is not None else Config()
+        self.predict_fun = predict_fun  # init-score hook for continued training
+
+    # ----------------------------------------------------------- from file
+    def load_from_file(self, filename, rank=0, num_machines=1) -> CoreDataset:
+        cfg = self.config
+        bin_path = str(filename) + ".bin"
+        if cfg.enable_load_from_binary_file and os.path.exists(bin_path):
+            try:
+                ds = CoreDataset.load_binary(bin_path)
+                Log.info("Loaded binary dataset %s", bin_path)
+                self._attach_init_score(ds)
+                return ds
+            except Exception:
+                pass  # fall through to text load
+
+        label, feats, names, fmt = parse_text_file(
+            filename, has_header=cfg.has_header, label_column=cfg.label_column)
+        weight_idx, group_idx, ignore, categorical = self._resolve_columns(
+            names, feats.shape[1])
+
+        meta = Metadata(len(label))
+        meta.set_label(label)
+        if weight_idx >= 0:
+            meta.set_weights(feats[:, weight_idx])
+            ignore.add(weight_idx)
+        if group_idx >= 0:
+            # group column holds a query id per row; convert to counts
+            qid = feats[:, group_idx].astype(np.int64)
+            _, counts = np.unique(qid, return_counts=True)
+            meta.set_query(counts)
+            ignore.add(group_idx)
+        meta.load_side_files(filename)
+
+        ds = self._construct(feats, names, ignore, categorical, meta)
+        self._attach_init_score(ds)
+        if cfg.is_save_binary_file:
+            ds.save_binary(bin_path)
+        return ds
+
+    def load_from_file_align_with_other_dataset(self, filename, train_ds) -> CoreDataset:
+        """Valid-set path: bin with the TRAIN mappers (dataset_loader.cpp:222-266)."""
+        cfg = self.config
+        label, feats, names, fmt = parse_text_file(
+            filename, has_header=cfg.has_header, label_column=cfg.label_column)
+        meta = Metadata(len(label))
+        meta.set_label(label)
+        weight_idx, group_idx, ignore, _ = self._resolve_columns(names, feats.shape[1])
+        if weight_idx >= 0:
+            meta.set_weights(feats[:, weight_idx])
+        if group_idx >= 0:
+            qid = feats[:, group_idx].astype(np.int64)
+            _, counts = np.unique(qid, return_counts=True)
+            meta.set_query(counts)
+        meta.load_side_files(filename)
+        ds = self._bin_with_mappers(feats, train_ds, meta)
+        self._attach_init_score(ds)
+        return ds
+
+    # --------------------------------------------------------- from matrix
+    def construct_from_matrix(self, data, label=None, reference=None,
+                              categorical_features=()) -> CoreDataset:
+        """In-memory path (c_api.cpp LGBM_DatasetCreateFromMat:268-315)."""
+        data = np.ascontiguousarray(np.asarray(data, dtype=np.float32))
+        data = np.nan_to_num(data, nan=0.0)
+        meta = Metadata(data.shape[0])
+        if label is not None:
+            meta.set_label(label)
+        if reference is not None:
+            return self._bin_with_mappers(data, reference, meta)
+        categorical = set(int(c) for c in categorical_features)
+        return self._construct(data, None, set(), categorical, meta)
+
+    # ------------------------------------------------------------ internals
+    def _resolve_columns(self, names, num_cols):
+        """weight/group/ignore/categorical column resolution. Indices do not
+        count the label column (config.h:116-131)."""
+        cfg = self.config
+
+        def resolve(spec):
+            if spec == "" or spec is None:
+                return -1
+            s = str(spec)
+            if s.startswith("name:"):
+                if names is None:
+                    Log.fatal("Cannot use name: column selector without header")
+                return names.index(s[5:])
+            return int(s)
+
+        weight_idx = resolve(cfg.weight_column)
+        group_idx = resolve(cfg.group_column)
+        ignore = set()
+        if cfg.ignore_column:
+            for tok in str(cfg.ignore_column).split(","):
+                idx = resolve(tok)
+                if idx >= 0:
+                    ignore.add(idx)
+        categorical = set()
+        if cfg.categorical_column:
+            for tok in str(cfg.categorical_column).split(","):
+                idx = resolve(tok)
+                if idx >= 0:
+                    categorical.add(idx)
+        return weight_idx, group_idx, ignore, categorical
+
+    def _sample_rows(self, n):
+        cfg = self.config
+        cnt = min(cfg.bin_construct_sample_cnt, n)
+        if cnt == n:
+            return np.arange(n, dtype=np.int64)
+        rnd = Random(cfg.data_random_seed)
+        return rnd.sample(n, cnt).astype(np.int64)
+
+    def _construct(self, feats, names, ignore, categorical, meta) -> CoreDataset:
+        """Bin-mapper construction + feature extraction
+        (ConstructBinMappersFromTextData + ExtractFeatures, dataset_loader.cpp:612-841)."""
+        cfg = self.config
+        n, num_total = feats.shape
+        sample_idx = self._sample_rows(n)
+        sample = feats[sample_idx]
+
+        ds = CoreDataset()
+        ds.num_total_features = num_total
+        ds.label_idx = self.config.label_column and 0 or 0
+        ds.feature_names = (list(names) if names is not None
+                            else [f"Column_{i}" for i in range(num_total)])
+
+        used_map = np.full(num_total, -1, dtype=np.int32)
+        mappers, real_idx, bin_cols = [], [], []
+        for j in range(num_total):
+            if j in ignore:
+                continue
+            col_sample = sample[:, j].astype(np.float64)
+            nonzero = col_sample[np.abs(col_sample) > ZERO_THRESHOLD]
+            btype = CATEGORICAL if j in categorical else NUMERICAL
+            m = BinMapper().find_bin(nonzero, len(col_sample), cfg.max_bin, btype)
+            if m.is_trivial:
+                Log.warning("Ignoring Column_%d , only has one value", j)
+                continue
+            used_map[j] = len(mappers)
+            real_idx.append(j)
+            mappers.append(m)
+            bin_cols.append(m.value_to_bin(feats[:, j]))
+
+        if not mappers:
+            Log.fatal("Cannot construct Dataset since there are no useful features. "
+                      "It should be at least two unique rows.")
+
+        dtype = np.uint8 if max(m.num_bin for m in mappers) <= 256 else np.uint16
+        ds.bins = np.stack([c.astype(dtype) for c in bin_cols], axis=0)
+        ds.bin_mappers = mappers
+        ds.used_feature_map = used_map
+        ds.real_feature_idx = np.asarray(real_idx, dtype=np.int32)
+        ds.metadata = meta
+        Log.info("Number of data: %d, number of features: %d", n, len(mappers))
+        return ds
+
+    def _bin_with_mappers(self, feats, ref_ds: CoreDataset, meta) -> CoreDataset:
+        ds = CoreDataset()
+        ds.num_total_features = ref_ds.num_total_features
+        ds.label_idx = ref_ds.label_idx
+        ds.feature_names = ref_ds.feature_names
+        ds.bin_mappers = ref_ds.bin_mappers
+        ds.used_feature_map = ref_ds.used_feature_map
+        ds.real_feature_idx = ref_ds.real_feature_idx
+        if feats.shape[1] < ref_ds.num_total_features:
+            Log.fatal("Validation data has fewer features than training data")
+        cols = [m.value_to_bin(feats[:, j]).astype(ref_ds.bins.dtype)
+                for j, m in zip(ref_ds.real_feature_idx, ref_ds.bin_mappers)]
+        ds.bins = np.stack(cols, axis=0)
+        ds.metadata = meta
+        return ds
+
+    def _attach_init_score(self, ds):
+        """Continued-training init scores via predictor hook
+        (application.cpp:108-115)."""
+        if self.predict_fun is not None and ds.metadata.init_score is None:
+            raw = self.predict_fun(ds)
+            ds.metadata.set_init_score(np.asarray(raw, dtype=np.float64).reshape(-1, order="F"))

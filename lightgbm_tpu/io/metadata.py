@@ -1,0 +1,137 @@
+"""Metadata: labels, weights, query boundaries, init scores.
+
+Reference: include/LightGBM/dataset.h:36-246, src/io/metadata.cpp.
+Side files `<data>.weight`, `<data>.query`, `<data>.init` are auto-loaded
+(metadata.cpp:382-457). Query weights are derived when both weights and
+queries exist (sum of weights per query / query count).
+"""
+
+import os
+
+import numpy as np
+
+from ..utils.log import Log
+
+
+class Metadata:
+    def __init__(self, num_data=0):
+        self.num_data = int(num_data)
+        self.label = np.zeros(self.num_data, dtype=np.float32)
+        self.weights = None            # (N,) float32 or None
+        self.query_boundaries = None   # (num_queries+1,) int32 or None
+        self.query_weights = None
+        self.init_score = None         # (N*num_class,) float64 or None
+
+    # ------------------------------------------------------------ side files
+    def load_side_files(self, data_filename):
+        wf = str(data_filename) + ".weight"
+        qf = str(data_filename) + ".query"
+        inf = str(data_filename) + ".init"
+        if os.path.exists(wf):
+            self.set_weights(np.loadtxt(wf, dtype=np.float32, ndmin=1))
+            Log.info("Loading weights...")
+        if os.path.exists(qf):
+            counts = np.loadtxt(qf, dtype=np.int64, ndmin=1)
+            self.set_query(counts)
+            Log.info("Loading query boundaries...")
+        if os.path.exists(inf):
+            self.set_init_score(np.loadtxt(inf, dtype=np.float64, ndmin=1))
+            Log.info("Loading initial scores...")
+
+    # --------------------------------------------------------------- setters
+    def set_label(self, label):
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if self.num_data and len(label) != self.num_data:
+            Log.fatal("Length of label is not same with #data")
+        self.label = label
+        self.num_data = len(label)
+
+    def set_weights(self, weights):
+        if weights is None:
+            self.weights = None
+            return
+        weights = np.asarray(weights, dtype=np.float32).reshape(-1)
+        if self.num_data and len(weights) != self.num_data:
+            Log.fatal("Length of weights is not same with #data")
+        self.weights = weights
+        self._maybe_query_weights()
+
+    def set_query(self, group):
+        """group: per-query doc counts (the `.query` file / `group` field)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        bounds = np.zeros(len(group) + 1, dtype=np.int32)
+        np.cumsum(group, out=bounds[1:])
+        if self.num_data and bounds[-1] != self.num_data:
+            Log.fatal("Sum of query counts (%d) is not same with #data (%d)",
+                      int(bounds[-1]), self.num_data)
+        self.query_boundaries = bounds
+        self._maybe_query_weights()
+
+    def set_init_score(self, init_score):
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64).reshape(-1)
+
+    def _maybe_query_weights(self):
+        # metadata.cpp: query weight = mean of record weights inside the query
+        if self.weights is not None and self.query_boundaries is not None:
+            nq = len(self.query_boundaries) - 1
+            sums = np.add.reduceat(self.weights, self.query_boundaries[:-1])
+            cnts = np.diff(self.query_boundaries)
+            self.query_weights = (sums / np.maximum(cnts, 1)).astype(np.float32)
+
+    @property
+    def num_queries(self):
+        if self.query_boundaries is None:
+            return 0
+        return len(self.query_boundaries) - 1
+
+    def subset(self, indices):
+        """Row subset preserving side data (used by Dataset.subset / cv)."""
+        indices = np.asarray(indices, dtype=np.int64)
+        out = Metadata(len(indices))
+        out.label = self.label[indices]
+        if self.weights is not None:
+            out.weights = self.weights[indices]
+        if self.init_score is not None:
+            ncls = len(self.init_score) // max(self.num_data, 1)
+            parts = [self.init_score[k * self.num_data + indices] for k in range(ncls)]
+            out.init_score = np.concatenate(parts)
+        # queries: only valid when indices keep whole queries in order; the
+        # reference has the same constraint (metadata.cpp CheckOrPartition).
+        if self.query_boundaries is not None:
+            qb = self.query_boundaries
+            qid = np.searchsorted(qb, indices, side="right") - 1
+            keep, first_pos = np.unique(qid, return_index=True)
+            counts = np.bincount(qid - qid.min(), minlength=len(keep))
+            counts = counts[counts > 0]
+            out.set_query(counts)
+        out._maybe_query_weights()
+        return out
+
+    def to_dict(self):
+        d = {"label": self.label, "num_data": self.num_data}
+        if self.weights is not None:
+            d["weights"] = self.weights
+        if self.query_boundaries is not None:
+            d["query_boundaries"] = self.query_boundaries
+        if self.init_score is not None:
+            d["init_score"] = self.init_score
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        m = cls(int(d["num_data"]))
+        m.label = np.asarray(d["label"], dtype=np.float32)
+        if "weights" in d:
+            m.weights = np.asarray(d["weights"], dtype=np.float32)
+        if "query_boundaries" in d:
+            m.query_boundaries = np.asarray(d["query_boundaries"], dtype=np.int32)
+        if "init_score" in d:
+            m.init_score = np.asarray(d["init_score"], dtype=np.float64)
+        m._maybe_query_weights()
+        return m

@@ -1,0 +1,4 @@
+from .metrics import Metric, create_metric
+from .dcg_calculator import DCGCalculator
+
+__all__ = ["Metric", "create_metric", "DCGCalculator"]

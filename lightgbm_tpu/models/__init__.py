@@ -1,0 +1,5 @@
+from .tree import Tree
+from .gbdt import GBDT, create_boosting
+from .dart import DART
+
+__all__ = ["Tree", "GBDT", "DART", "create_boosting"]

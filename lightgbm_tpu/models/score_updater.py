@@ -1,0 +1,47 @@
+"""ScoreUpdater: per-dataset model scores.
+
+Reference: src/boosting/score_updater.hpp:15-85. Scores live on device as
+a (num_class, N) float32 array. Train-set updates use the tree builder's
+final row->leaf partition (a pure gather — the analog of the reference's
+via-partition fast path Tree::AddPredictionToScore(tree_learner)); valid
+sets are traversed in bin space on host.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ScoreUpdater:
+    def __init__(self, dataset, num_class):
+        self.dataset = dataset
+        self.num_class = int(num_class)
+        n = dataset.num_data
+        self.num_data = n
+        init = dataset.metadata.init_score
+        if init is not None:
+            if len(init) != n * self.num_class:
+                from ..utils.log import Log
+                Log.fatal("Number of class for initial score error")
+            self.score = jnp.asarray(
+                np.asarray(init, dtype=np.float32).reshape(self.num_class, n))
+        else:
+            self.score = jnp.zeros((self.num_class, n), dtype=jnp.float32)
+
+    def add_score_by_partition(self, leaf_values, row_leaf, curr_class):
+        """score += leaf_values[row_leaf] (device gather)."""
+        upd = jnp.take(jnp.asarray(leaf_values, dtype=jnp.float32), row_leaf)
+        self.score = self.score.at[curr_class].add(upd)
+
+    def add_score_by_tree(self, tree, curr_class):
+        """Host bin-space traversal (valid sets / re-scoring loaded models)."""
+        vals = tree.predict_by_bins(self.dataset.bins).astype(np.float32)
+        self.score = self.score.at[curr_class].add(jnp.asarray(vals))
+
+    def sub_score_by_tree(self, tree, curr_class):
+        vals = tree.predict_by_bins(self.dataset.bins).astype(np.float32)
+        self.score = self.score.at[curr_class].add(jnp.asarray(-vals))
+
+    def host_score(self):
+        """Flat class-major (K*N,) float64 host array (the reference's
+        score layout, score[k*N + i])."""
+        return np.asarray(self.score, dtype=np.float64).reshape(-1)

@@ -1,0 +1,201 @@
+"""Tree model: flat-array binary tree + text/JSON serialization.
+
+Reference: include/LightGBM/tree.h:18-198, src/io/tree.cpp:24-231.
+Leaves are encoded as `~leaf_index` (negative) in the child arrays.
+The text format round-trips with the reference's model files (same
+field names, same `Tree=i` block layout), which is the compatibility
+contract exercised by the reference tests.
+
+Unlike the reference (which grows node arrays via repeated Split calls)
+the TPU build materializes a whole tree's arrays in one device program
+(models/tree_learner.py) and wraps them here for serialization and
+host-side prediction; prediction is vectorized over rows with a
+node-pointer iteration instead of a per-row walk.
+"""
+
+import numpy as np
+
+from ..utils import common
+from ..utils.log import Log
+
+
+class Tree:
+    NUMERICAL = 0
+    CATEGORICAL = 1
+
+    def __init__(self, num_leaves=1):
+        n = max(int(num_leaves), 1)
+        self.num_leaves = n
+        self.split_feature = np.zeros(max(n - 1, 0), dtype=np.int32)       # inner idx
+        self.split_feature_real = np.zeros(max(n - 1, 0), dtype=np.int32)  # column idx
+        self.threshold_in_bin = np.zeros(max(n - 1, 0), dtype=np.int32)
+        self.threshold = np.zeros(max(n - 1, 0), dtype=np.float64)
+        self.decision_type = np.zeros(max(n - 1, 0), dtype=np.int8)
+        self.split_gain = np.zeros(max(n - 1, 0), dtype=np.float64)
+        self.left_child = np.zeros(max(n - 1, 0), dtype=np.int32)
+        self.right_child = np.zeros(max(n - 1, 0), dtype=np.int32)
+        self.leaf_parent = np.full(n, -1, dtype=np.int32)
+        self.leaf_value = np.zeros(n, dtype=np.float64)
+        self.leaf_count = np.zeros(n, dtype=np.int32)
+        self.internal_value = np.zeros(max(n - 1, 0), dtype=np.float64)
+        self.internal_count = np.zeros(max(n - 1, 0), dtype=np.int32)
+
+    # ------------------------------------------------------------- training
+    def shrinkage(self, rate):
+        """Scale leaf outputs by the learning rate (tree.h:103-107)."""
+        self.leaf_value *= rate
+
+    @property
+    def max_depth(self):
+        """Longest root->leaf path (for bounding vectorized traversal)."""
+        if self.num_leaves <= 1:
+            return 0
+        depth = np.zeros(self.num_leaves - 1, dtype=np.int32)
+        best = 1
+        for node in range(self.num_leaves - 1):
+            d = depth[node]
+            for child in (self.left_child[node], self.right_child[node]):
+                if child >= 0:
+                    depth[child] = d + 1
+                    best = max(best, d + 2)
+                else:
+                    best = max(best, d + 1)
+        return best
+
+    # ----------------------------------------------------------- prediction
+    def get_leaf(self, x):
+        """Vectorized leaf lookup on raw feature values.
+
+        x: (N, num_total_features) float array. Returns (N,) leaf indices.
+        Equivalent to tree.h:226-238 per row.
+        """
+        x = np.asarray(x, dtype=np.float64)
+        n = x.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        active = node >= 0
+        for _ in range(self.max_depth + 1):
+            if not active.any():
+                break
+            nd = node[active]
+            feat = self.split_feature_real[nd]
+            thr = self.threshold[nd]
+            dt = self.decision_type[nd]
+            fval = x[active, feat]
+            go_left = np.where(dt == self.CATEGORICAL,
+                               fval.astype(np.int64) == thr.astype(np.int64),
+                               fval <= thr)
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            node[active] = nxt
+            active = node >= 0
+        return (~node).astype(np.int32)
+
+    def predict(self, x):
+        return self.leaf_value[self.get_leaf(x)]
+
+    def get_leaf_by_bins(self, bins):
+        """Leaf lookup on a binned (F, N) matrix (tree.h:211-224); used to
+        add scores on aligned train/valid datasets."""
+        n = bins.shape[1]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        active = node >= 0
+        for _ in range(self.max_depth + 1):
+            if not active.any():
+                break
+            nd = node[active]
+            feat = self.split_feature[nd]
+            thr = self.threshold_in_bin[nd]
+            dt = self.decision_type[nd]
+            fval = bins[feat, np.nonzero(active)[0]].astype(np.int64)
+            go_left = np.where(dt == self.CATEGORICAL, fval == thr, fval <= thr)
+            node[active] = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            active = node >= 0
+        return (~node).astype(np.int32)
+
+    def predict_by_bins(self, bins):
+        return self.leaf_value[self.get_leaf_by_bins(bins)]
+
+    # -------------------------------------------------------- serialization
+    def to_string(self):
+        """Text block (tree.cpp ToString)."""
+        n = self.num_leaves
+        lines = [
+            f"num_leaves={n}",
+            "split_feature=" + common.array_to_string(self.split_feature_real[:n - 1]),
+            "split_gain=" + common.array_to_string(self.split_gain[:n - 1].astype(np.float64)),
+            "threshold=" + common.array_to_string(self.threshold[:n - 1].astype(np.float64)),
+            "decision_type=" + common.array_to_string(self.decision_type[:n - 1]),
+            "left_child=" + common.array_to_string(self.left_child[:n - 1]),
+            "right_child=" + common.array_to_string(self.right_child[:n - 1]),
+            "leaf_parent=" + common.array_to_string(self.leaf_parent[:n]),
+            "leaf_value=" + common.array_to_string(self.leaf_value[:n].astype(np.float64)),
+            "leaf_count=" + common.array_to_string(self.leaf_count[:n]),
+            "internal_value=" + common.array_to_string(self.internal_value[:n - 1].astype(np.float64)),
+            "internal_count=" + common.array_to_string(self.internal_count[:n - 1]),
+        ]
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_string(cls, s):
+        """Parse a `Tree=i` block (tree.cpp:192-230)."""
+        kv = {}
+        for line in s.split("\n"):
+            parts = line.split("=", 1)
+            if len(parts) == 2 and parts[0].strip() and parts[1].strip():
+                kv[parts[0].strip()] = parts[1].strip()
+        required = ("num_leaves", "split_feature", "split_gain", "threshold",
+                    "left_child", "right_child", "leaf_parent", "leaf_value",
+                    "internal_value", "internal_count", "leaf_count", "decision_type")
+        for key in required:
+            if key not in kv:
+                Log.fatal("Tree model string format error: missing %s", key)
+        n = int(kv["num_leaves"])
+        t = cls(n)
+        if n > 1:
+            t.left_child = common.string_to_array(kv["left_child"], int)
+            t.right_child = common.string_to_array(kv["right_child"], int)
+            t.split_feature_real = common.string_to_array(kv["split_feature"], int)
+            t.split_feature = t.split_feature_real.copy()  # inner map unknown after load
+            t.threshold = common.string_to_array(kv["threshold"], float)
+            t.split_gain = common.string_to_array(kv["split_gain"], float)
+            t.internal_count = common.string_to_array(kv["internal_count"], int)
+            t.internal_value = common.string_to_array(kv["internal_value"], float)
+            t.decision_type = common.string_to_array(kv["decision_type"], int).astype(np.int8)
+        t.leaf_count = common.string_to_array(kv["leaf_count"], int)
+        t.leaf_parent = common.string_to_array(kv["leaf_parent"], int)
+        t.leaf_value = common.string_to_array(kv["leaf_value"], float)
+        return t
+
+    def to_json(self):
+        out = [f'"num_leaves":{self.num_leaves},']
+        out.append(f'"tree_structure":{self._node_to_json(0 if self.num_leaves > 1 else ~0)}')
+        return "\n".join(out) + "\n"
+
+    def _node_to_json(self, index):
+        if index >= 0 and self.num_leaves > 1:
+            dt = "no_greater" if self.decision_type[index] == 0 else "is"
+            return (
+                "{\n"
+                f'"split_index":{index},\n'
+                f'"split_feature":{int(self.split_feature_real[index])},\n'
+                f'"split_gain":{self.split_gain[index]:g},\n'
+                f'"threshold":{self.threshold[index]:g},\n'
+                f'"decision_type":"{dt}",\n'
+                f'"internal_value":{self.internal_value[index]:g},\n'
+                f'"internal_count":{int(self.internal_count[index])},\n'
+                f'"left_child":{self._node_to_json(self.left_child[index])},\n'
+                f'"right_child":{self._node_to_json(self.right_child[index])}\n'
+                "}"
+            )
+        index = ~index if index < 0 else index
+        return (
+            "{\n"
+            f'"leaf_index":{index},\n'
+            f'"leaf_parent":{int(self.leaf_parent[index])},\n'
+            f'"leaf_value":{self.leaf_value[index]:g},\n'
+            f'"leaf_count":{int(self.leaf_count[index])}\n'
+            "}"
+        )

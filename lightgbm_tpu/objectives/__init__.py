@@ -1,0 +1,25 @@
+"""Objective functions: gradients/hessians from scores.
+
+Reference: src/objective/ (regression_objective.hpp, binary_objective.hpp,
+multiclass_objective.hpp, rank_objective.hpp), factory
+src/objective/objective_function.cpp:9-20.
+
+Scores and gradients are (num_class, N) device arrays; the elementwise
+objectives are jitted jnp code. Lambdarank's per-query pairwise pass runs
+as padded-batch device code would in a later revision; v1 computes it on
+host with fully vectorized numpy per query (the reference is also a
+host-side O(n_q^2) loop; this is not the training bottleneck at the
+reference's query sizes).
+"""
+
+from .objectives import (
+    ObjectiveFunction,
+    RegressionL2loss,
+    BinaryLogloss,
+    MulticlassLogloss,
+    LambdarankNDCG,
+    create_objective,
+)
+
+__all__ = ["ObjectiveFunction", "RegressionL2loss", "BinaryLogloss",
+           "MulticlassLogloss", "LambdarankNDCG", "create_objective"]

@@ -1,0 +1,4 @@
+from .histogram import build_histograms
+from .split import find_best_split, leaf_split_gain, leaf_output
+
+__all__ = ["build_histograms", "find_best_split", "leaf_split_gain", "leaf_output"]

@@ -1,0 +1,182 @@
+"""Best-split search: vectorized cumulative scan over (feature, bin).
+
+Reference: src/treelearner/feature_histogram.hpp:116-246 (right-to-left
+threshold scan with min_data / min_sum_hessian / min_gain constraints)
+and :290-313 (L1/L2-regularized gain and leaf-output formulas).
+
+The reference scans each feature's bins serially per leaf; here every
+(feature, threshold) candidate is evaluated at once with a reversed
+cumulative sum, constraints become masks, and the argmax reproduces the
+reference's tie-breaking: among equal gains the LARGEST threshold wins
+(the serial scan runs from high t to low t and only replaces on strictly
+greater gain), and across features the SMALLEST feature index wins
+(SplitInfo::operator>, split_info.hpp:98-103).
+
+Epsilon conventions replicated from the reference:
+  - parent sum_hessians gets +2*kEpsilon (feature_histogram.hpp:59)
+  - the right-side hessian accumulator starts at kEpsilon (:123)
+  - categorical uses the raw per-bin hessian for the "current" side (:197)
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+K_EPSILON = 1e-15
+K_MIN_SCORE = -jnp.inf
+
+
+class SplitParams(NamedTuple):
+    """Static split constraints (TreeConfig, config.h:166-186)."""
+    min_data_in_leaf: float
+    min_sum_hessian_in_leaf: float
+    lambda_l1: float
+    lambda_l2: float
+    min_gain_to_split: float
+
+
+class SplitInfo(NamedTuple):
+    """Best split of one leaf (src/treelearner/split_info.hpp:17-104)."""
+    gain: jnp.ndarray
+    feature: jnp.ndarray
+    threshold: jnp.ndarray
+    left_sum_gradient: jnp.ndarray
+    left_sum_hessian: jnp.ndarray
+    left_count: jnp.ndarray
+    right_sum_gradient: jnp.ndarray
+    right_sum_hessian: jnp.ndarray
+    right_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+def _threshold_l1(s, l1):
+    return jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_split_gain(sum_g, sum_h, l1, l2):
+    """GetLeafSplitGain (feature_histogram.hpp:290-298)."""
+    reg = _threshold_l1(sum_g, l1)
+    return jnp.where(reg > 0.0, reg * reg / (sum_h + l2), 0.0)
+
+
+def leaf_output(sum_g, sum_h, l1, l2):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:306-313)."""
+    reg = _threshold_l1(sum_g, l1)
+    return jnp.where(reg > 0.0, -jnp.sign(sum_g) * reg / (sum_h + l2), 0.0)
+
+
+def find_best_split(hist, sum_g, sum_h, num_data,
+                    num_bin_per_feature, is_categorical, feature_mask,
+                    params: SplitParams) -> SplitInfo:
+    """Best split over all features of one leaf.
+
+    Args:
+      hist: (F, B, 3) float32 — per (feature, bin) [sum_grad, sum_hess, count].
+      sum_g, sum_h, num_data: scalar leaf totals (in-bag).
+      num_bin_per_feature: (F,) int32.
+      is_categorical: (F,) bool.
+      feature_mask: (F,) bool — feature_fraction sampling for this tree.
+      params: SplitParams.
+    """
+    f, b, _ = hist.shape
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    c = hist[:, :, 2]
+
+    sum_h_eps = sum_h + 2.0 * K_EPSILON
+    gain_shift = leaf_split_gain(sum_g, sum_h_eps, params.lambda_l1, params.lambda_l2)
+    min_gain_shift = gain_shift + params.min_gain_to_split
+
+    # ---------------- numerical: thresholds t in [0, B-2], left = bin <= t
+    rcum_g = jnp.cumsum(g[:, ::-1], axis=1)[:, ::-1]  # rcum[:, j] = sum_{b >= j}
+    rcum_h = jnp.cumsum(h[:, ::-1], axis=1)[:, ::-1]
+    rcum_c = jnp.cumsum(c[:, ::-1], axis=1)[:, ::-1]
+
+    right_g = rcum_g[:, 1:]                       # (F, B-1), t = 0..B-2
+    right_h = rcum_h[:, 1:] + K_EPSILON           # accumulator seed (hpp:123)
+    right_c = rcum_c[:, 1:]
+    left_c = num_data - right_c
+    left_h = sum_h_eps - right_h
+    left_g = sum_g - right_g
+
+    num_valid = ((right_c >= params.min_data_in_leaf)
+                 & (left_c >= params.min_data_in_leaf)
+                 & (right_h >= params.min_sum_hessian_in_leaf)
+                 & (left_h >= params.min_sum_hessian_in_leaf))
+    num_gain = (leaf_split_gain(left_g, left_h, params.lambda_l1, params.lambda_l2)
+                + leaf_split_gain(right_g, right_h, params.lambda_l1, params.lambda_l2))
+    num_valid &= num_gain >= min_gain_shift
+    num_score = jnp.where(num_valid, num_gain, K_MIN_SCORE)
+
+    # tie-break: largest threshold -> argmax over reversed axis
+    rev = num_score[:, ::-1]
+    t_rev = jnp.argmax(rev, axis=1)
+    num_best_t = (b - 2) - t_rev                              # (F,)
+    num_best_gain = jnp.take_along_axis(num_score, num_best_t[:, None], axis=1)[:, 0]
+
+    # ---------------- categorical: one-vs-rest on bin t (hpp:187-246)
+    cur_g, cur_h_raw, cur_c = g, h, c
+    oth_c = num_data - cur_c
+    oth_h = sum_h_eps - cur_h_raw
+    oth_g = sum_g - cur_g
+    cat_valid = ((cur_c >= params.min_data_in_leaf)
+                 & (oth_c >= params.min_data_in_leaf)
+                 & (cur_h_raw >= params.min_sum_hessian_in_leaf)
+                 & (oth_h >= params.min_sum_hessian_in_leaf))
+    cat_gain = (leaf_split_gain(cur_g, cur_h_raw, params.lambda_l1, params.lambda_l2)
+                + leaf_split_gain(oth_g, oth_h, params.lambda_l1, params.lambda_l2))
+    cat_valid &= cat_gain >= min_gain_shift
+    cat_score = jnp.where(cat_valid, cat_gain, K_MIN_SCORE)
+    cat_t_rev = jnp.argmax(cat_score[:, ::-1], axis=1)
+    cat_best_t = (b - 1) - cat_t_rev
+    cat_best_gain = jnp.take_along_axis(cat_score, cat_best_t[:, None], axis=1)[:, 0]
+
+    # ---------------- merge numerical/categorical per feature
+    best_t = jnp.where(is_categorical, cat_best_t, num_best_t).astype(jnp.int32)
+    best_gain_f = jnp.where(is_categorical, cat_best_gain, num_best_gain)
+    best_gain_f = jnp.where(feature_mask, best_gain_f, K_MIN_SCORE)
+
+    # across features: first max = smallest feature id (matches SplitInfo tie-break)
+    best_f = jnp.argmax(best_gain_f).astype(jnp.int32)
+    best_gain = best_gain_f[best_f]
+    best_thr = best_t[best_f]
+
+    # ---------------- reconstruct child sums for the winner
+    is_cat = is_categorical[best_f]
+    # numerical left/right at (best_f, best_thr)
+    n_right_g = rcum_g[best_f, best_thr + 1]
+    n_right_h = rcum_h[best_f, best_thr + 1] + K_EPSILON
+    n_right_c = rcum_c[best_f, best_thr + 1]
+    n_left_g = sum_g - n_right_g
+    n_left_h = sum_h_eps - n_right_h
+    n_left_c = num_data - n_right_c
+    # categorical: left = the chosen bin, right = rest
+    c_left_g = g[best_f, best_thr]
+    c_left_h = h[best_f, best_thr]
+    c_left_c = c[best_f, best_thr]
+    c_right_g = sum_g - c_left_g
+    c_right_h = sum_h_eps - c_left_h
+    c_right_c = num_data - c_left_c
+
+    lg = jnp.where(is_cat, c_left_g, n_left_g)
+    lh = jnp.where(is_cat, c_left_h, n_left_h)
+    lc = jnp.where(is_cat, c_left_c, n_left_c)
+    rg = jnp.where(is_cat, c_right_g, n_right_g)
+    rh = jnp.where(is_cat, c_right_h, n_right_h)
+    rc = jnp.where(is_cat, c_right_c, n_right_c)
+
+    lout = leaf_output(lg, lh, params.lambda_l1, params.lambda_l2)
+    rout = leaf_output(rg, rh, params.lambda_l1, params.lambda_l2)
+
+    found = best_gain > K_MIN_SCORE
+    out_gain = jnp.where(found, best_gain - gain_shift, K_MIN_SCORE)
+
+    return SplitInfo(
+        gain=out_gain,
+        feature=best_f,
+        threshold=best_thr,
+        left_sum_gradient=lg, left_sum_hessian=lh, left_count=lc,
+        right_sum_gradient=rg, right_sum_hessian=rh, right_count=rc,
+        left_output=lout, right_output=rout,
+    )

@@ -1,0 +1,4 @@
+class LGBMModel: pass
+class LGBMRegressor: pass
+class LGBMClassifier: pass
+class LGBMRanker: pass

@@ -1,0 +1,5 @@
+from .log import Log
+from .random import Random
+from . import common
+
+__all__ = ["Log", "Random", "common"]

@@ -1,0 +1,81 @@
+"""String/array helpers mirroring the reference's Common namespace.
+
+Reference: include/LightGBM/utils/common.h:21-397. Fast Atof with na/inf
+handling, array<->string converters used by the model text format.
+"""
+
+import math
+
+import numpy as np
+
+
+def atof(s: str) -> float:
+    """Parse a double; 'na'/'nan'/'inf' handled (common.h Atof)."""
+    s = s.strip()
+    if not s:
+        return 0.0
+    low = s.lower()
+    if low in ("na", "nan", "null"):
+        return math.nan
+    try:
+        return float(s)  # handles inf/-inf natively
+    except ValueError:
+        return math.nan
+
+
+def atoi(s: str) -> int:
+    return int(s.strip())
+
+
+def array_to_string(arr, sep=" ") -> str:
+    """Join array with C++ stream formatting.
+
+    The reference serializes doubles via std::stringstream (6 significant
+    digits by default)... except `ArrayToString<double>` uses operator<<
+    which gives '%g'-style output. We keep full repr precision for doubles
+    to make save->load->predict exact round trips; the reference's loader
+    (Common::StringToArray) accepts any float formatting.
+    """
+    out = []
+    for v in arr:
+        if isinstance(v, (float, np.floating)):
+            if math.isinf(v):
+                out.append("inf" if v > 0 else "-inf")
+            else:
+                out.append(repr(float(v)))
+        else:
+            out.append(str(int(v)))
+    return sep.join(out)
+
+
+def string_to_array(s: str, dtype, sep=" "):
+    parts = [p for p in s.split(sep) if p]
+    if dtype is float:
+        return np.asarray([atof(p) for p in parts], dtype=np.float64)
+    return np.asarray([int(p) for p in parts], dtype=np.int32)
+
+
+def softmax(x, axis=-1):
+    """Stable softmax (common.h:307-322 works on a vector)."""
+    x = np.asarray(x, dtype=np.float64)
+    m = np.max(x, axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+def param_dict_to_str(params: dict) -> str:
+    """Serialize params the way the reference python package does
+    (basic.py:112-144): 'k1=v1 k2=v2', lists joined by ','."""
+    if not params:
+        return ""
+    pairs = []
+    for k, v in params.items():
+        if isinstance(v, (list, tuple)):
+            pairs.append(f"{k}={','.join(map(str, v))}")
+        elif isinstance(v, bool):
+            pairs.append(f"{k}={'true' if v else 'false'}")
+        elif v is None:
+            continue
+        else:
+            pairs.append(f"{k}={v}")
+    return " ".join(pairs)

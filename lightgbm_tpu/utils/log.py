@@ -1,0 +1,64 @@
+"""Leveled logger mirroring the reference's static Log class.
+
+Reference: include/LightGBM/utils/log.h:14-98. Fatal raises (the reference
+throws std::runtime_error caught at the CLI / C-API boundary).
+"""
+
+import sys
+
+
+class LightGBMError(Exception):
+    """Error raised by the framework (reference: basic.py LightGBMError)."""
+
+
+class Log:
+    # levels: fatal=-1, warning=0, info=1, debug=2
+    _level = 1
+
+    @classmethod
+    def reset_log_level(cls, level: int) -> None:
+        cls._level = level
+
+    @classmethod
+    def set_level_from_verbosity(cls, verbosity: int) -> None:
+        # reference: src/io/config.cpp:63-74
+        if verbosity == 1:
+            cls._level = 1
+        elif verbosity == 0:
+            cls._level = 0
+        elif verbosity >= 2:
+            cls._level = 2
+        else:
+            cls._level = -1
+
+    @classmethod
+    def debug(cls, fmt, *args):
+        if cls._level >= 2:
+            cls._write("Debug", fmt, args)
+
+    @classmethod
+    def info(cls, fmt, *args):
+        if cls._level >= 1:
+            cls._write("Info", fmt, args)
+
+    @classmethod
+    def warning(cls, fmt, *args):
+        if cls._level >= 0:
+            cls._write("Warning", fmt, args)
+
+    @classmethod
+    def fatal(cls, fmt, *args):
+        msg = (fmt % args) if args else str(fmt)
+        raise LightGBMError(msg)
+
+    @staticmethod
+    def _write(level_str, fmt, args):
+        msg = (fmt % args) if args else str(fmt)
+        sys.stdout.write(f"[LightGBM-TPU] [{level_str}] {msg}\n")
+        sys.stdout.flush()
+
+
+def check(condition, msg="check failed"):
+    """CHECK macro equivalent (log.h:86-98)."""
+    if not condition:
+        Log.fatal(msg)

@@ -1,0 +1,48 @@
+"""Seeded RNG mirroring the reference's mt19937 wrapper.
+
+Reference: include/LightGBM/utils/random.h:14-73. Backed by numpy's MT19937
+(the same core generator); the draw order of `uniform_int_distribution` is
+implementation-defined in C++, so exact bit-parity with a given libstdc++ is
+not guaranteed — the *algorithms* (sequential K-of-N selection sampling,
+bagging probabilities) are identical.
+"""
+
+import numpy as np
+
+
+class Random:
+    def __init__(self, seed=None):
+        if seed is None:
+            self._rng = np.random.RandomState()
+        else:
+            self._rng = np.random.RandomState(seed & 0xFFFFFFFF)
+
+    def next_int(self, lower: int, upper: int) -> int:
+        """Random integer in [lower, upper)."""
+        return int(self._rng.randint(lower, upper))
+
+    def next_double(self) -> float:
+        """Random float in [0, 1)."""
+        return float(self._rng.random_sample())
+
+    def sample(self, n: int, k: int) -> np.ndarray:
+        """K ordered samples from {0..N-1} via sequential selection sampling
+        (random.h:55-68)."""
+        if k > n or k < 0:
+            return np.empty(0, dtype=np.int32)
+        # vectorized equivalent of the sequential scheme: draw u_i and keep
+        # i if u_i < (k - taken) / (n - i). Done in one pass on host.
+        u = self._rng.random_sample(n)
+        out = []
+        taken = 0
+        for i in range(n):
+            if u[i] < (k - taken) / (n - i):
+                out.append(i)
+                taken += 1
+        return np.asarray(out, dtype=np.int32)
+
+    def sample_mask(self, n: int, k: int) -> np.ndarray:
+        """Boolean mask variant of `sample`."""
+        mask = np.zeros(n, dtype=bool)
+        mask[self.sample(n, k)] = True
+        return mask
