@@ -240,11 +240,11 @@ class Config:
             if isinstance(cur, bool):
                 setattr(cfg, key, _parse_bool(value))
             elif isinstance(cur, int) or cur is None and key != "seed":
-                setattr(cfg, key, int(float(value)) if not isinstance(value, str) else int(float(value)))
+                setattr(cfg, key, int(float(value)))
             elif isinstance(cur, float):
                 setattr(cfg, key, float(value))
             else:
-                setattr(cfg, key, value if not isinstance(value, str) else value)
+                setattr(cfg, key, value)
 
         # seed fan-out (config.cpp:40-47)
         if "seed" in params:

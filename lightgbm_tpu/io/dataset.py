@@ -31,6 +31,18 @@ from .parser import parse_text_file, ZERO_THRESHOLD
 BINARY_MAGIC = "lightgbm_tpu_dataset_v1"
 
 
+def _qid_to_counts(qid_col):
+    """Row-order run-length encoding of a per-row query-id column into
+    per-query counts (Metadata::LoadQueryBoundaries semantics,
+    metadata.cpp:358-371)."""
+    qid = np.asarray(qid_col).astype(np.int64)
+    if len(qid) == 0:
+        return np.zeros(0, dtype=np.int64)
+    change = np.nonzero(np.diff(qid))[0] + 1
+    edges = np.concatenate([[0], change, [len(qid)]])
+    return np.diff(edges)
+
+
 class CoreDataset:
     """Eagerly-binned dataset (the reference's `Dataset`, dataset.h:278-421)."""
 
@@ -114,7 +126,8 @@ class CoreDataset:
                 arrays[f"mapper{i}_{k}"] = np.asarray(v)
         for k, v in self.metadata.to_dict().items():
             arrays[f"meta_{k}"] = np.asarray(v)
-        np.savez_compressed(path, magic=np.asarray(BINARY_MAGIC), **arrays)
+        with open(path, "wb") as f:  # keep the exact path (savez appends .npz)
+            np.savez_compressed(f, magic=np.asarray(BINARY_MAGIC), **arrays)
         Log.info("Saved binary dataset to %s", str(path))
 
     @classmethod
@@ -161,7 +174,7 @@ class DatasetLoader:
             except Exception:
                 pass  # fall through to text load
 
-        label, feats, names, fmt = parse_text_file(
+        label, feats, names, fmt, label_idx = parse_text_file(
             filename, has_header=cfg.has_header, label_column=cfg.label_column)
         weight_idx, group_idx, ignore, categorical = self._resolve_columns(
             names, feats.shape[1])
@@ -172,14 +185,15 @@ class DatasetLoader:
             meta.set_weights(feats[:, weight_idx])
             ignore.add(weight_idx)
         if group_idx >= 0:
-            # group column holds a query id per row; convert to counts
-            qid = feats[:, group_idx].astype(np.int64)
-            _, counts = np.unique(qid, return_counts=True)
-            meta.set_query(counts)
+            # group column holds a query id per row; run-length encode in ROW
+            # order (metadata.cpp:358-371) — np.unique would sort by qid value
+            # and merge non-adjacent runs
+            meta.set_query(_qid_to_counts(feats[:, group_idx]))
             ignore.add(group_idx)
         meta.load_side_files(filename)
 
         ds = self._construct(feats, names, ignore, categorical, meta)
+        ds.label_idx = label_idx
         self._attach_init_score(ds)
         if cfg.is_save_binary_file:
             ds.save_binary(bin_path)
@@ -188,7 +202,7 @@ class DatasetLoader:
     def load_from_file_align_with_other_dataset(self, filename, train_ds) -> CoreDataset:
         """Valid-set path: bin with the TRAIN mappers (dataset_loader.cpp:222-266)."""
         cfg = self.config
-        label, feats, names, fmt = parse_text_file(
+        label, feats, names, fmt, _ = parse_text_file(
             filename, has_header=cfg.has_header, label_column=cfg.label_column)
         meta = Metadata(len(label))
         meta.set_label(label)
@@ -196,9 +210,7 @@ class DatasetLoader:
         if weight_idx >= 0:
             meta.set_weights(feats[:, weight_idx])
         if group_idx >= 0:
-            qid = feats[:, group_idx].astype(np.int64)
-            _, counts = np.unique(qid, return_counts=True)
-            meta.set_query(counts)
+            meta.set_query(_qid_to_counts(feats[:, group_idx]))
         meta.load_side_files(filename)
         ds = self._bin_with_mappers(feats, train_ds, meta)
         self._attach_init_score(ds)
@@ -268,7 +280,6 @@ class DatasetLoader:
 
         ds = CoreDataset()
         ds.num_total_features = num_total
-        ds.label_idx = self.config.label_column and 0 or 0
         ds.feature_names = (list(names) if names is not None
                             else [f"Column_{i}" for i in range(num_total)])
 

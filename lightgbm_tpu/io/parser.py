@@ -86,7 +86,8 @@ def _parse_libsvm(path, has_header):
 
 
 def parse_text_file(path, has_header=False, label_column=""):
-    """Parse a data file into (label, features (N, C-1) float32, header names).
+    """Parse a data file into
+    (label, features (N, C-1) float32, header names, format, label_idx).
 
     label/weight/group column resolution follows the reference
     (`DatasetLoader::SetHeader`, dataset_loader.cpp:57-160): label defaults
@@ -98,7 +99,7 @@ def parse_text_file(path, has_header=False, label_column=""):
     fmt = detect_format(path)
     if fmt == "libsvm":
         label, mat, names = _parse_libsvm(path, has_header)
-        return label, mat, names, fmt
+        return label, mat, names, fmt, 0
 
     sep = "," if fmt == "csv" else "\t"
     df = pd.read_csv(path, sep=sep, header=0 if has_header else None,
@@ -122,4 +123,4 @@ def parse_text_file(path, has_header=False, label_column=""):
     feat_names = None
     if names is not None:
         feat_names = [n for i, n in enumerate(names) if i != label_idx]
-    return label, feats, feat_names, fmt
+    return label, feats, feat_names, fmt, label_idx

@@ -80,6 +80,8 @@ class GBDT:
         if data_changed:
             if self.tree_learner is None:
                 self.tree_learner = create_tree_learner(config.tree_learner, config)
+            else:
+                self.tree_learner.config = config
             self.tree_learner.init(train_data)
             self.training_metrics = list(training_metrics)
             self.train_score_updater = ScoreUpdater(train_data, self.num_class)
@@ -94,7 +96,8 @@ class GBDT:
             self.feature_names = list(train_data.feature_names)
         self.train_data = train_data
         self.config = config
-        if self.tree_learner is not None:
+        # data_changed already init'ed the learner with this config
+        if self.tree_learner is not None and not data_changed:
             self.tree_learner.reset_config(config)
 
     def add_valid_dataset(self, valid_data, valid_metrics):
@@ -182,12 +185,12 @@ class GBDT:
         return self.train_score_updater.score
 
     def rollback_one_iter(self):
-        """gbdt.cpp:247-264."""
-        if self.iter == 0:
+        """gbdt.cpp:247-264. Indexes from the end of the model list so it
+        stays valid after early-stopping truncation."""
+        if self.iter == 0 or len(self.models) < self.num_class:
             return
-        cur_iter = self.iter + self.num_init_iteration - 1
         for k in range(self.num_class):
-            tree = self.models[cur_iter * self.num_class + k]
+            tree = self.models[-self.num_class + k]
             tree.shrinkage(-1.0)
             self.train_score_updater.add_score_by_tree(tree, k)
             for updater in self.valid_score_updaters:
@@ -197,15 +200,30 @@ class GBDT:
 
     # ------------------------------------------------------------ evaluation
     def eval_and_check_early_stopping(self):
-        """gbdt.cpp:266-281."""
+        """gbdt.cpp:266-281. Unlike the reference (which only pops the model
+        list), the dropped trees' score contributions are also subtracted so
+        the booster state stays consistent for rollback / continued use."""
         best_msg = self.output_metric(self.iter)
         if best_msg:
             Log.info("Early stopping at iteration %d, the best iteration round is %d",
                      self.iter, self.iter - self.early_stopping_round)
             Log.info("Output of best iteration round:\n%s", best_msg)
-            del self.models[-self.early_stopping_round * self.num_class:]
+            self._truncate_iters(self.early_stopping_round)
             return True
         return False
+
+    def _truncate_iters(self, k):
+        """Drop the last k iterations, subtracting their score contributions
+        in one batched pass per dataset (the reference only pops the model
+        list, gbdt.cpp:271-279, leaving scores stale)."""
+        k = min(k, self.iter)
+        if k <= 0:
+            return
+        dropped = self.models[-k * self.num_class:]
+        del self.models[-k * self.num_class:]
+        self.iter -= k
+        for updater in [self.train_score_updater] + self.valid_score_updaters:
+            updater.sub_score_by_trees(dropped, self.num_class)
 
     def output_metric(self, it):
         """gbdt.cpp:292-349: print metrics, track early stopping."""

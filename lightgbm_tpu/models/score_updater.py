@@ -41,6 +41,14 @@ class ScoreUpdater:
         vals = tree.predict_by_bins(self.dataset.bins).astype(np.float32)
         self.score = self.score.at[curr_class].add(jnp.asarray(-vals))
 
+    def sub_score_by_trees(self, trees, num_class):
+        """Batched subtraction of many class-major trees: one host pass and
+        ONE device update total (used by early-stopping truncation)."""
+        delta = np.zeros((self.num_class, self.num_data), dtype=np.float32)
+        for i, tree in enumerate(trees):
+            delta[i % num_class] -= tree.predict_by_bins(self.dataset.bins)
+        self.score = self.score + jnp.asarray(delta)
+
     def host_score(self):
         """Flat class-major (K*N,) float64 host array (the reference's
         score layout, score[k*N + i])."""

@@ -84,7 +84,8 @@ class Tree:
             dt = self.decision_type[nd]
             fval = x[active, feat]
             go_left = np.where(dt == self.CATEGORICAL,
-                               fval.astype(np.int64) == thr.astype(np.int64),
+                               np.nan_to_num(fval).astype(np.int64)
+                               == thr.astype(np.int64),
                                fval <= thr)
             nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
             node[active] = nxt

@@ -47,7 +47,8 @@ def _identity_psum(x):
 def build_tree_device(bins, grad, hess, inbag, feature_mask,
                       num_bin_pf, is_cat,
                       *, num_leaves, max_bin, params: SplitParams,
-                      max_depth, row_chunk, psum_fn=_identity_psum):
+                      max_depth, row_chunk, psum_fn=_identity_psum,
+                      evaluate_fn=None):
     """Grow one leaf-wise tree on device. All shapes static.
 
     Args:
@@ -57,7 +58,13 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
       feature_mask: (F,) bool feature_fraction mask.
       num_bin_pf: (F,) int32 bins per feature; is_cat: (F,) bool.
       num_leaves/max_bin/params/max_depth/row_chunk: static config.
-      psum_fn: collective reduction for data-parallel mode.
+      psum_fn: explicit collective reduction (shard_map learners); under
+        GSPMD auto-sharding this stays identity and XLA inserts the
+        collectives from the input shardings.
+      evaluate_fn: optional (local_hist3, sum_g, sum_h, cnt) -> SplitInfo
+        override receiving the UN-reduced local histogram — the
+        voting-parallel learner injects its top-k vote + selective psum
+        here (voting_parallel_tree_learner.cpp:137-293).
 
     Returns a dict of tree arrays + the final row->leaf partition.
     """
@@ -67,11 +74,13 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
     f32 = jnp.float32
 
     def hist_fn(ghc):
-        return psum_fn(build_histograms(bins, ghc, b, row_chunk))
+        return build_histograms(bins, ghc, b, row_chunk)
 
-    def scan_leaf(hist3, sum_g, sum_h, cnt):
-        return find_best_split(hist3, sum_g, sum_h, cnt,
-                               num_bin_pf, is_cat, feature_mask, params)
+    if evaluate_fn is None:
+        def evaluate_fn(hist3, sum_g, sum_h, cnt):
+            return find_best_split(psum_fn(hist3), sum_g, sum_h, cnt,
+                                   num_bin_pf, is_cat, feature_mask, params)
+    scan_leaf = evaluate_fn
 
     # ---- root ----------------------------------------------------------
     g_in = grad * inbag
@@ -242,15 +251,27 @@ class SerialTreeLearner:
         self.num_data = train_set.num_data
         self.max_bin = int(train_set.max_num_bin)
         chunk = int(cfg.device_row_chunk)
-        n_pad = ((self.num_data + chunk - 1) // chunk) * chunk if self.num_data > chunk else self.num_data
+        n_pad = self._pad_rows(self.num_data, chunk)
         self.n_pad = n_pad
+        chunk = self._effective_chunk(chunk)
+        self.row_chunk = chunk
         bins = train_set.bins
         if n_pad != self.num_data:
             pad = np.zeros((bins.shape[0], n_pad - self.num_data), dtype=bins.dtype)
             bins = np.concatenate([bins, pad], axis=1)
-        self._bins = jnp.asarray(bins)
-        self._num_bin_pf = jnp.asarray(train_set.num_bin_array())
-        self._is_cat = jnp.asarray(train_set.feature_is_categorical())
+        f_pad = self._pad_feature_count(self.num_features)
+        self.f_pad = f_pad
+        num_bin_pf = train_set.num_bin_array()
+        is_cat = train_set.feature_is_categorical()
+        if f_pad != self.num_features:
+            extra = f_pad - self.num_features
+            bins = np.concatenate(
+                [bins, np.zeros((extra, bins.shape[1]), dtype=bins.dtype)], axis=0)
+            num_bin_pf = np.concatenate([num_bin_pf, np.ones(extra, np.int32)])
+            is_cat = np.concatenate([is_cat, np.zeros(extra, bool)])
+        self._bins = self._place_bins(bins)
+        self._num_bin_pf = jnp.asarray(num_bin_pf)
+        self._is_cat = jnp.asarray(is_cat)
         self.params = SplitParams(
             min_data_in_leaf=float(cfg.min_data_in_leaf),
             min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
@@ -258,20 +279,35 @@ class SerialTreeLearner:
             lambda_l2=float(cfg.lambda_l2),
             min_gain_to_split=float(cfg.min_gain_to_split),
         )
-        self._build = jax.jit(functools.partial(
+        self._build = self._make_build_fn(cfg, chunk)
+        Log.info("Number of data: %d, number of features: %d",
+                 self.num_data, self.num_features)
+
+    # hooks overridden by the parallel learners (parallel/learners.py) -------
+    def _pad_rows(self, n, chunk):
+        return ((n + chunk - 1) // chunk) * chunk if n > chunk else n
+
+    def _effective_chunk(self, chunk):
+        return min(chunk, self.n_pad)
+
+    def _pad_feature_count(self, f):
+        return f
+
+    def _place_bins(self, bins):
+        return jnp.asarray(bins)
+
+    def _place_rows(self, arr):
+        return arr
+
+    def _make_build_fn(self, cfg, chunk):
+        return jax.jit(functools.partial(
             build_tree_device,
             num_leaves=int(cfg.num_leaves),
             max_bin=self.max_bin,
             params=self.params,
             max_depth=int(cfg.max_depth),
             row_chunk=chunk,
-            psum_fn=self._psum,
         ))
-        Log.info("Number of data: %d, number of features: %d",
-                 self.num_data, self.num_features)
-
-    def _psum(self, x):
-        return x
 
     def reset_config(self, config):
         self.config = config
@@ -282,9 +318,14 @@ class SerialTreeLearner:
         """feature_fraction per tree (serial_tree_learner.cpp:160-165)."""
         cfg = self.config
         if cfg.feature_fraction >= 1.0:
-            return np.ones(self.num_features, dtype=bool)
-        used_cnt = int(self.num_features * cfg.feature_fraction)
-        return self.random.sample_mask(self.num_features, max(used_cnt, 1))
+            mask = np.ones(self.num_features, dtype=bool)
+        else:
+            used_cnt = int(self.num_features * cfg.feature_fraction)
+            mask = self.random.sample_mask(self.num_features, max(used_cnt, 1))
+        if self.f_pad != self.num_features:
+            mask = np.concatenate(
+                [mask, np.zeros(self.f_pad - self.num_features, bool)])
+        return mask
 
     def train(self, grad, hess, inbag=None):
         """Grow one tree. grad/hess: (N,) device or host float32.
@@ -302,6 +343,9 @@ class SerialTreeLearner:
             grad = jnp.pad(grad, (0, n_pad - n))
             hess = jnp.pad(hess, (0, n_pad - n))
             inbag = jnp.pad(inbag, (0, n_pad - n))
+        grad = self._place_rows(grad)
+        hess = self._place_rows(hess)
+        inbag = self._place_rows(inbag)
         fmask = jnp.asarray(self._sample_features())
         out = self._build(self._bins, grad, hess, inbag, fmask,
                           self._num_bin_pf, self._is_cat)
@@ -339,8 +383,12 @@ def create_tree_learner(learner_type, config):
     """Factory (src/treelearner/tree_learner.cpp:8-19)."""
     if learner_type == "serial":
         return SerialTreeLearner(config)
-    from ..parallel.learners import (
-        DataParallelTreeLearner, FeatureParallelTreeLearner, VotingParallelTreeLearner)
+    try:
+        from ..parallel.learners import (
+            DataParallelTreeLearner, FeatureParallelTreeLearner,
+            VotingParallelTreeLearner)
+    except ImportError as e:
+        Log.fatal("Parallel tree learner %s is unavailable: %s", learner_type, e)
     if learner_type == "data":
         return DataParallelTreeLearner(config)
     if learner_type == "feature":

@@ -66,10 +66,14 @@ def leaf_output(sum_g, sum_h, l1, l2):
     return jnp.where(reg > 0.0, -jnp.sign(sum_g) * reg / (sum_h + l2), 0.0)
 
 
-def find_best_split(hist, sum_g, sum_h, num_data,
-                    num_bin_per_feature, is_categorical, feature_mask,
-                    params: SplitParams) -> SplitInfo:
-    """Best split over all features of one leaf.
+def per_feature_best(hist, sum_g, sum_h, num_data,
+                     num_bin_per_feature, is_categorical, feature_mask,
+                     params: SplitParams):
+    """Best (gain, threshold) of every feature for one leaf.
+
+    Returns (best_gain_f, best_t): two (F,) arrays. Used directly by the
+    voting-parallel learner's local top-k vote
+    (voting_parallel_tree_learner.cpp:137-166) and by find_best_split.
 
     Args:
       hist: (F, B, 3) float32 — per (feature, bin) [sum_grad, sum_hess, count].
@@ -136,18 +140,41 @@ def find_best_split(hist, sum_g, sum_h, num_data,
     best_t = jnp.where(is_categorical, cat_best_t, num_best_t).astype(jnp.int32)
     best_gain_f = jnp.where(is_categorical, cat_best_gain, num_best_gain)
     best_gain_f = jnp.where(feature_mask, best_gain_f, K_MIN_SCORE)
+    return best_gain_f, best_t
 
+
+def find_best_split(hist, sum_g, sum_h, num_data,
+                    num_bin_per_feature, is_categorical, feature_mask,
+                    params: SplitParams) -> SplitInfo:
+    """Best split over all features of one leaf (see per_feature_best)."""
+    best_gain_f, best_t = per_feature_best(
+        hist, sum_g, sum_h, num_data, num_bin_per_feature, is_categorical,
+        feature_mask, params)
     # across features: first max = smallest feature id (matches SplitInfo tie-break)
     best_f = jnp.argmax(best_gain_f).astype(jnp.int32)
-    best_gain = best_gain_f[best_f]
-    best_thr = best_t[best_f]
+    return split_info_at(hist, sum_g, sum_h, num_data, is_categorical, params,
+                         best_f, best_t[best_f], best_gain_f[best_f])
 
-    # ---------------- reconstruct child sums for the winner
+
+def split_info_at(hist, sum_g, sum_h, num_data, is_categorical, params,
+                  best_f, best_thr, best_gain) -> SplitInfo:
+    """Reconstruct the full SplitInfo of a chosen (feature, threshold)."""
+    g = hist[:, :, 0]
+    h = hist[:, :, 1]
+    c = hist[:, :, 2]
+    sum_h_eps = sum_h + 2.0 * K_EPSILON
+    gain_shift = leaf_split_gain(sum_g, sum_h_eps, params.lambda_l1, params.lambda_l2)
+    rcum_g = jnp.cumsum(g[:, ::-1], axis=1)[:, ::-1]
+    rcum_h = jnp.cumsum(h[:, ::-1], axis=1)[:, ::-1]
+    rcum_c = jnp.cumsum(c[:, ::-1], axis=1)[:, ::-1]
+
+    b = hist.shape[1]
     is_cat = is_categorical[best_f]
     # numerical left/right at (best_f, best_thr)
-    n_right_g = rcum_g[best_f, best_thr + 1]
-    n_right_h = rcum_h[best_f, best_thr + 1] + K_EPSILON
-    n_right_c = rcum_c[best_f, best_thr + 1]
+    thr_next = jnp.minimum(best_thr + 1, b - 1)
+    n_right_g = rcum_g[best_f, thr_next]
+    n_right_h = rcum_h[best_f, thr_next] + K_EPSILON
+    n_right_c = rcum_c[best_f, thr_next]
     n_left_g = sum_g - n_right_g
     n_left_h = sum_h_eps - n_right_h
     n_left_c = num_data - n_right_c

@@ -1,0 +1,27 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Mirrors SURVEY.md §4's implication: multi-device learners are
+unit-testable single-process via xla_force_host_platform_device_count.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The image's sitecustomize registers the TPU-tunnel backend regardless of
+# JAX_PLATFORMS; override the platform choice explicitly.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.RandomState(42)

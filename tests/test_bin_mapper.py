@@ -1,0 +1,81 @@
+"""BinMapper semantics (reference src/io/bin.cpp:44-268)."""
+
+import numpy as np
+
+from lightgbm_tpu.io.bin_mapper import BinMapper, NUMERICAL, CATEGORICAL
+
+
+def test_few_distinct_values_midpoint_bounds():
+    # <= max_bin distinct values: bounds are midpoints, last is +inf
+    vals = np.array([1.0, 2.0, 2.0, 5.0])
+    m = BinMapper().find_bin(vals, total_sample_cnt=4, max_bin=255)
+    assert m.num_bin == 3
+    np.testing.assert_allclose(m.bin_upper_bound, [1.5, 3.5, np.inf])
+    assert m.value_to_bin(np.array([0.9, 1.5, 1.6, 3.5, 100.0])).tolist() == [0, 0, 1, 1, 2]
+
+
+def test_zero_block_inserted():
+    # zeros are implied by total_sample_cnt - len(values)
+    vals = np.array([3.0, 3.0, 7.0])
+    m = BinMapper().find_bin(vals, total_sample_cnt=10, max_bin=255)
+    # distinct values: 0 (cnt 7), 3 (cnt 2), 7 (cnt 1)
+    assert m.num_bin == 3
+    np.testing.assert_allclose(m.bin_upper_bound, [1.5, 5.0, np.inf])
+
+
+def test_negative_values_zero_inserted_in_order():
+    vals = np.array([-2.0, 4.0])
+    m = BinMapper().find_bin(vals, total_sample_cnt=4, max_bin=255)
+    assert m.num_bin == 3
+    np.testing.assert_allclose(m.bin_upper_bound, [-1.0, 2.0, np.inf])
+    assert m.value_to_bin(np.array([-5.0, 0.0, 9.0])).tolist() == [0, 1, 2]
+
+
+def test_greedy_equal_frequency_many_values(rng):
+    vals = rng.randn(20000)
+    m = BinMapper().find_bin(vals, total_sample_cnt=20000, max_bin=64)
+    assert m.num_bin <= 64
+    assert m.num_bin > 50  # continuous data should fill most bins
+    bins = m.value_to_bin(vals)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    # equal-frequency: no bin should be wildly overloaded
+    assert counts.max() < 20000 / 64 * 4
+    assert np.all(np.diff(m.bin_upper_bound[:-1]) > 0)
+
+
+def test_categorical_top_count_order():
+    # categories sorted by count; bin 0 = most frequent
+    vals = np.array([5] * 10 + [2] * 7 + [9] * 3, dtype=np.float64)
+    m = BinMapper().find_bin(vals, total_sample_cnt=20, max_bin=255,
+                             bin_type=CATEGORICAL)
+    assert m.bin_type == CATEGORICAL
+    assert m.bin_2_categorical.tolist() == [5, 2, 9]
+    assert m.value_to_bin(np.array([5, 2, 9, 777])).tolist() == [0, 1, 2, 0]
+
+
+def test_categorical_max_bin_cap():
+    vals = np.repeat(np.arange(100), np.arange(100, 0, -1)).astype(np.float64)
+    m = BinMapper().find_bin(vals, total_sample_cnt=len(vals), max_bin=10,
+                             bin_type=CATEGORICAL)
+    assert m.num_bin == 10
+    assert m.bin_2_categorical.tolist() == list(range(10))
+
+
+def test_trivial_feature():
+    m = BinMapper().find_bin(np.array([]), total_sample_cnt=100, max_bin=255)
+    assert m.is_trivial
+
+
+def test_roundtrip_serialization(rng):
+    vals = rng.randn(1000)
+    m = BinMapper().find_bin(vals, total_sample_cnt=1000, max_bin=32)
+    m2 = BinMapper.from_dict(m.to_dict())
+    assert m == m2
+    np.testing.assert_array_equal(m.value_to_bin(vals), m2.value_to_bin(vals))
+
+
+def test_nan_maps_like_zero():
+    m = BinMapper().find_bin(np.array([-1.0, 1.0]), total_sample_cnt=4, max_bin=255)
+    b_nan = m.value_to_bin(np.array([np.nan]))[0]
+    b_zero = m.value_to_bin(np.array([0.0]))[0]
+    assert b_nan == b_zero

@@ -1,0 +1,165 @@
+"""End-to-end core training: metric-threshold tests mirroring the
+reference suite (tests/python_package_test/test_engine.py:40-66 uses
+binary logloss<0.15, regression RMSE<4, multiclass mlogloss<0.2)."""
+
+import numpy as np
+import pytest
+from sklearn import datasets
+from sklearn.model_selection import train_test_split
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import DatasetLoader
+from lightgbm_tpu.metrics import create_metric
+from lightgbm_tpu.models.gbdt import create_boosting
+from lightgbm_tpu.objectives import create_objective
+
+
+def _train(cfg, X, y, num_rounds=50):
+    ds = DatasetLoader(cfg).construct_from_matrix(X, label=y)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    gbdt = create_boosting(cfg.boosting_type)
+    gbdt.init(cfg, ds, obj, [])
+    for _ in range(num_rounds):
+        if gbdt.train_one_iter(is_eval=False):
+            break
+    return gbdt, ds
+
+
+def test_binary_breast_cancer():
+    X, y = datasets.load_breast_cancer(return_X_y=True)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, test_size=0.1, random_state=42)
+    cfg = Config(objective="binary", num_leaves=31, learning_rate=0.1,
+                 min_data_in_leaf=10, metric="binary_logloss", verbose=-1)
+    gbdt, _ = _train(cfg, X_tr, y_tr, 50)
+    p = gbdt.predict(X_te)[:, 0]
+    logloss = -np.mean(y_te * np.log(np.clip(p, 1e-15, 1))
+                       + (1 - y_te) * np.log(np.clip(1 - p, 1e-15, 1)))
+    assert logloss < 0.15  # reference threshold (test_engine.py:47)
+
+
+def test_regression_rmse():
+    X, y = datasets.make_regression(n_samples=506, n_features=13, noise=5.0,
+                                    random_state=42)
+    y = y / np.std(y) * 9.0 + 22.0  # boston-like scale
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, test_size=0.1, random_state=42)
+    cfg = Config(objective="regression", num_leaves=31, learning_rate=0.1,
+                 min_data_in_leaf=5, metric="l2", verbose=-1)
+    gbdt, _ = _train(cfg, X_tr, y_tr, 100)
+    pred = gbdt.predict(X_te)[:, 0]
+    rmse = np.sqrt(np.mean((pred - y_te) ** 2))
+    assert rmse < 4  # reference threshold (test_engine.py:53)
+
+
+def test_multiclass_digits():
+    X, y = datasets.load_digits(return_X_y=True)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, test_size=0.1, random_state=42)
+    cfg = Config(objective="multiclass", num_class=10, num_leaves=31,
+                 learning_rate=0.1, min_data_in_leaf=5, metric="multi_logloss",
+                 verbose=-1)
+    gbdt, _ = _train(cfg, X_tr, y_tr, 50)
+    p = gbdt.predict(X_te)  # (N, 10) softmax
+    mlogloss = -np.mean(np.log(np.clip(p[np.arange(len(y_te)), y_te], 1e-15, 1)))
+    assert mlogloss < 0.2  # reference threshold (test_engine.py:64)
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X, y = datasets.load_breast_cancer(return_X_y=True)
+    cfg = Config(objective="binary", num_leaves=15, learning_rate=0.1,
+                 min_data_in_leaf=10, verbose=-1)
+    gbdt, _ = _train(cfg, X, y, 10)
+    p1 = gbdt.predict(X)
+    path = str(tmp_path / "model.txt")
+    gbdt.save_model_to_file(-1, path)
+
+    from lightgbm_tpu.models.gbdt import create_boosting as cb
+    g2 = cb("gbdt", input_model=path) if False else cb("gbdt")
+    with open(path) as f:
+        g2.load_model_from_string(f.read())
+    p2 = g2.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-9)
+
+
+def test_early_stopping_and_rollback():
+    X, y = datasets.load_breast_cancer(return_X_y=True)
+    X_tr, X_va, y_tr, y_va = train_test_split(X, y, test_size=0.2, random_state=0)
+    cfg = Config(objective="binary", num_leaves=31, learning_rate=0.3,
+                 min_data_in_leaf=10, metric="binary_logloss",
+                 early_stopping_round=5, verbose=-1)
+    loader = DatasetLoader(cfg)
+    ds = loader.construct_from_matrix(X_tr, label=y_tr)
+    vs = loader.construct_from_matrix(X_va, label=y_va, reference=ds)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    met = create_metric("binary_logloss", cfg)
+    met.init(vs.metadata, vs.num_data)
+    gbdt = create_boosting("gbdt")
+    gbdt.init(cfg, ds, obj, [])
+    gbdt.add_valid_dataset(vs, [met])
+    stopped = False
+    for _ in range(200):
+        if gbdt.train_one_iter():
+            stopped = True
+            break
+    assert stopped
+    # rollback works
+    n = len(gbdt.models)
+    gbdt.rollback_one_iter()
+    assert len(gbdt.models) == n or len(gbdt.models) == n - 1
+
+
+def test_bagging_and_feature_fraction():
+    X, y = datasets.load_breast_cancer(return_X_y=True)
+    cfg = Config(objective="binary", num_leaves=31, learning_rate=0.1,
+                 bagging_fraction=0.7, bagging_freq=1, feature_fraction=0.7,
+                 min_data_in_leaf=10, verbose=-1)
+    gbdt, _ = _train(cfg, X, y, 30)
+    p = gbdt.predict(X)[:, 0]
+    err = np.mean((p > 0.5) != y)
+    assert err < 0.05
+
+
+def test_dart_trains():
+    X, y = datasets.load_breast_cancer(return_X_y=True)
+    cfg = Config(objective="binary", boosting_type="dart", num_leaves=15,
+                 learning_rate=0.1, min_data_in_leaf=10, drop_rate=0.1,
+                 verbose=-1)
+    gbdt, _ = _train(cfg, X, y, 30)
+    p = gbdt.predict(X)[:, 0]
+    err = np.mean((p > 0.5) != y)
+    assert err < 0.1
+
+
+def test_dataset_binary_cache_roundtrip(tmp_path, rng):
+    X = rng.randn(200, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    cfg = Config(verbose=-1)
+    ds = DatasetLoader(cfg).construct_from_matrix(X, label=y)
+    path = str(tmp_path / "ds.bin")
+    ds.save_binary(path)
+    from lightgbm_tpu.io.dataset import CoreDataset
+    ds2 = CoreDataset.load_binary(path)
+    assert ds.check_align(ds2)
+    np.testing.assert_array_equal(ds.bins, ds2.bins)
+    np.testing.assert_array_equal(ds.metadata.label, ds2.metadata.label)
+
+
+def test_qid_run_length_encoding():
+    # row-order RLE, NOT sorted-unique (metadata.cpp:358-371)
+    from lightgbm_tpu.io.dataset import _qid_to_counts
+    counts = _qid_to_counts(np.array([7, 7, 7, 3, 3]))
+    assert counts.tolist() == [3, 2]
+    counts = _qid_to_counts(np.array([1, 1, 2, 1]))
+    assert counts.tolist() == [2, 1, 1]
+    assert _qid_to_counts(np.array([])).tolist() == []
+
+
+def test_subset_shares_mappers(rng):
+    X = rng.randn(300, 4).astype(np.float32)
+    y = rng.randn(300).astype(np.float32)
+    cfg = Config(verbose=-1)
+    ds = DatasetLoader(cfg).construct_from_matrix(X, label=y)
+    sub = ds.subset(np.arange(0, 300, 3))
+    assert sub.num_data == 100
+    assert sub.check_align(ds)
+    np.testing.assert_array_equal(sub.bins[:, 0], ds.bins[:, 0])

@@ -1,0 +1,134 @@
+"""Best-split search vs brute force (reference feature_histogram.hpp:116-313)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.split import (
+    SplitParams, find_best_split, leaf_split_gain, leaf_output, K_EPSILON)
+
+P0 = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0,
+                 lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0)
+
+
+def _np_gain(sg, sh, l1, l2):
+    reg = max(abs(sg) - l1, 0.0)
+    return reg * reg / (sh + l2) if reg > 0 else 0.0
+
+
+def _brute_force(hist, sum_g, sum_h, n, params, num_bin_pf):
+    """Replicates FindBestThresholdForNumerical's right-to-left scan."""
+    f, b, _ = hist.shape
+    sum_h_eps = sum_h + 2e-15
+    gain_shift = _np_gain(sum_g, sum_h_eps, params.lambda_l1, params.lambda_l2)
+    best = (-np.inf, -1, -1)
+    for fi in range(f):
+        for t in range(b - 1):
+            rg = hist[fi, t + 1:, 0].sum()
+            rh = hist[fi, t + 1:, 1].sum() + 1e-15
+            rc = hist[fi, t + 1:, 2].sum()
+            lg, lh, lc = sum_g - rg, sum_h_eps - rh, n - rc
+            if min(lc, rc) < params.min_data_in_leaf:
+                continue
+            if min(lh, rh) < params.min_sum_hessian_in_leaf:
+                continue
+            gain = (_np_gain(lg, lh, params.lambda_l1, params.lambda_l2)
+                    + _np_gain(rg, rh, params.lambda_l1, params.lambda_l2))
+            if gain < gain_shift + params.min_gain_to_split:
+                continue
+            # tie-breaks: larger threshold wins within feature; smaller
+            # feature wins across features — "strictly greater" replicates both
+            # given the iteration order below scans t ascending / f ascending
+            if gain > best[0] or (gain == best[0] and fi == best[1]):
+                best = (gain, fi, t)
+    return best
+
+
+def _run(hist_np, n, params=P0):
+    f, b, _ = hist_np.shape
+    sum_g = float(hist_np[0, :, 0].sum())
+    sum_h = float(hist_np[0, :, 1].sum())
+    num_bin_pf = jnp.full(f, b, dtype=jnp.int32)
+    sp = find_best_split(jnp.asarray(hist_np, dtype=jnp.float32),
+                         jnp.asarray(sum_g, dtype=jnp.float32),
+                         jnp.asarray(sum_h, dtype=jnp.float32),
+                         jnp.asarray(float(n), dtype=jnp.float32),
+                         num_bin_pf, jnp.zeros(f, dtype=bool),
+                         jnp.ones(f, dtype=bool), params)
+    return sp, sum_g, sum_h
+
+
+def test_matches_brute_force(rng):
+    for trial in range(10):
+        f, b = 4, 8
+        g = rng.randn(f, b).astype(np.float64)
+        h = np.abs(rng.randn(f, b)).astype(np.float64) + 0.1
+        c = rng.randint(1, 20, size=(f, b)).astype(np.float64)
+        # all features must share the same totals (same rows)
+        g[1:] = g[0].sum() / b
+        h[1:] = h[0].sum() / b
+        c[1:] = 0
+        c[1:, 0] = c[0].sum()
+        hist = np.stack([g, h, c], axis=-1)
+        sp, sum_g, sum_h = _run(hist, n=c[0].sum())
+        bf_gain, bf_f, bf_t = _brute_force(hist, sum_g, sum_h, c[0].sum(), P0,
+                                           None)
+        gain_shift = _np_gain(sum_g, sum_h + 2e-15, 0, 0)
+        if bf_gain == -np.inf:
+            assert float(sp.gain) == -np.inf
+        else:
+            assert int(sp.feature) == bf_f
+            assert int(sp.threshold) == bf_t
+            np.testing.assert_allclose(float(sp.gain), bf_gain - gain_shift,
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_min_data_constraint_blocks_split():
+    # single feature, 2 bins; one row left, many right
+    hist = np.zeros((1, 4, 3))
+    hist[0, 0] = [5.0, 1.0, 1]      # 1 row in bin 0
+    hist[0, 1] = [-5.0, 10.0, 99]   # 99 rows in bin 1
+    p = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=0.0,
+                    lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0)
+    sp, _, _ = _run(hist, n=100, params=p)
+    # only threshold t=0 separates; it leaves 1 row on the left -> blocked
+    assert float(sp.gain) == -np.inf
+
+
+def test_l2_regularization_shrinks_output():
+    out0 = float(leaf_output(jnp.asarray(-10.0), jnp.asarray(5.0), 0.0, 0.0))
+    out1 = float(leaf_output(jnp.asarray(-10.0), jnp.asarray(5.0), 0.0, 10.0))
+    assert out0 == 2.0
+    assert 0 < out1 < out0
+
+
+def test_l1_thresholding_zeroes_small_gradients():
+    assert float(leaf_split_gain(jnp.asarray(0.5), jnp.asarray(1.0), 1.0, 0.0)) == 0.0
+    assert float(leaf_output(jnp.asarray(0.5), jnp.asarray(1.0), 1.0, 0.0)) == 0.0
+
+
+def test_categorical_one_vs_rest(rng):
+    f, b = 2, 6
+    g = rng.randn(f, b)
+    h = np.abs(rng.randn(f, b)) + 0.1
+    c = np.full((f, b), 10.0)
+    g[1] = g[0]; h[1] = h[0]; c[1] = c[0]
+    hist = np.stack([g, h, c], axis=-1).astype(np.float32)
+    sum_g, sum_h, n = float(g[0].sum()), float(h[0].sum()), 60.0
+    sp = find_best_split(jnp.asarray(hist), jnp.asarray(sum_g, dtype=jnp.float32),
+                         jnp.asarray(sum_h, dtype=jnp.float32),
+                         jnp.asarray(n, dtype=jnp.float32),
+                         jnp.full(f, b, dtype=jnp.int32),
+                         jnp.asarray([True, False]),
+                         jnp.ones(f, dtype=bool), P0)
+    # categorical feature 0: brute-force one-vs-rest
+    sum_h_eps = sum_h + 2e-15
+    gain_shift = _np_gain(sum_g, sum_h_eps, 0, 0)
+    best = (-np.inf, -1)
+    for t in range(b):
+        lg, lh, lc = g[0, t], h[0, t], c[0, t]
+        rg, rh, rc = sum_g - lg, sum_h_eps - lh, n - lc
+        gain = _np_gain(lg, lh, 0, 0) + _np_gain(rg, rh, 0, 0)
+        if gain > best[0]:
+            best = (gain, t)
+    if int(sp.feature) == 0:
+        assert int(sp.threshold) == best[1]
