@@ -1,0 +1,85 @@
+"""Benchmark: single-chip GBDT training throughput vs the reference CPU.
+
+Workload: synthetic HIGGS-shaped binary classification, 1,000,000 rows x
+28 features, 100 boosting iterations, 63 leaves, max_bin=255 — the same
+data (seed 42) and config used to time the reference CLI.
+
+Baseline: reference LightGBM (C++, -O3, OpenMP) on this image's CPU:
+28.6 s for the 100-iteration training loop (training auc 0.9338,
+data load excluded for both sides). See BASELINE.md "Measured".
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline > 1 means faster than the reference.
+"""
+
+import json
+import time
+
+import numpy as np
+
+REF_TRAIN_SECONDS = 28.6
+N_ROWS = 1_000_000
+N_FEATURES = 28
+NUM_ITERATIONS = 100
+
+
+def make_data(n=N_ROWS, f=N_FEATURES, seed=42):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f).astype(np.float32) / np.sqrt(f)
+    logit = x @ w + 0.5 * rng.randn(n).astype(np.float32)
+    y = (logit > 0).astype(np.float32)
+    return x, y
+
+
+def main():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    from lightgbm_tpu.metrics import create_metric
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    cfg = Config.from_params({
+        "objective": "binary",
+        "num_leaves": 63,
+        "max_bin": 255,
+        "learning_rate": 0.1,
+        "num_iterations": NUM_ITERATIONS,
+        "metric": "auc",
+        "metric_freq": 0,  # no eval inside the timed loop
+    })
+
+    x, y = make_data()
+    ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+
+    objective = create_objective(cfg.objective, cfg)
+    objective.init(ds.metadata, ds.num_data)
+    booster = GBDT()
+    booster.init(cfg, ds, objective, [])
+
+    # warm-up: compile the tree builder (cached afterwards)
+    booster.train_one_iter(is_eval=False)
+
+    t0 = time.time()
+    for _ in range(NUM_ITERATIONS):
+        booster.train_one_iter(is_eval=False)
+    np.asarray(booster.get_training_score())  # block on device work
+    train_s = time.time() - t0
+
+    auc_metric = create_metric("auc", cfg)
+    auc_metric.init(ds.metadata, ds.num_data)
+    auc = float(auc_metric.eval(booster.get_training_score())[0])
+
+    print(json.dumps({
+        "metric": "train_time_1M x 28_binary_100iter_63leaves",
+        "value": round(train_s, 3),
+        "unit": "s",
+        "vs_baseline": round(REF_TRAIN_SECONDS / train_s, 3),
+        "auc": round(auc, 5),
+        "ref_auc": 0.9338,
+    }))
+
+
+if __name__ == "__main__":
+    main()

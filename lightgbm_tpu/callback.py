@@ -1,5 +1,134 @@
-class EarlyStopException(Exception): pass
-def print_evaluation(*a, **k): pass
-def record_evaluation(*a, **k): pass
-def reset_parameter(*a, **k): pass
-def early_stopping(*a, **k): pass
+"""Training callbacks.
+
+Reference: python-package/lightgbm/callback.py:6-192. Same callback
+contract: callables taking a `CallbackEnv`, ordered by `.order`, run
+before each iteration when `.before_iteration` is set, else after;
+`early_stopping` signals by raising `EarlyStopException`.
+"""
+
+import collections
+
+
+class EarlyStopException(Exception):
+    """Raised by the early_stopping callback (callback.py:6-15)."""
+
+    def __init__(self, best_iteration):
+        super().__init__()
+        self.best_iteration = best_iteration
+
+
+CallbackEnv = collections.namedtuple(
+    "LightGBMCallbackEnv",
+    ["model", "cvfolds", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def _format_eval_result(value, show_stdv=True):
+    """4-tuple (data, name, value, bigger_better) or 5-tuple (+std)."""
+    if len(value) == 4:
+        return "%s's %s:%g" % (value[0], value[1], value[2])
+    if len(value) == 5:
+        if show_stdv:
+            return "%s's %s:%g+%g" % (value[0], value[1], value[2], value[4])
+        return "%s's %s:%g" % (value[0], value[1], value[2])
+    raise ValueError("Wrong metric value")
+
+
+def print_evaluation(period=1, show_stdv=True):
+    """Print evaluation results every `period` iterations (callback.py:40-65)."""
+
+    def callback(env):
+        if not env.evaluation_result_list or period <= 0:
+            return
+        if (env.iteration + 1) % period == 0:
+            result = "\t".join(_format_eval_result(x, show_stdv)
+                               for x in env.evaluation_result_list)
+            print("[%d]\t%s" % (env.iteration + 1, result))
+    callback.order = 10
+    return callback
+
+
+def record_evaluation(eval_result):
+    """Record evaluation history into `eval_result` dict (callback.py:68-97)."""
+    if not isinstance(eval_result, dict):
+        raise TypeError("Eval_result should be a dictionary")
+    eval_result.clear()
+
+    def init(env):
+        for data_name, _, _, _ in env.evaluation_result_list:
+            eval_result.setdefault(data_name, collections.defaultdict(list))
+
+    def callback(env):
+        if not eval_result:
+            init(env)
+        for data_name, eval_name, result, _ in env.evaluation_result_list:
+            eval_result[data_name][eval_name].append(result)
+    callback.order = 20
+    return callback
+
+
+def reset_parameter(**kwargs):
+    """Reset parameters (e.g. learning_rate schedules) before each
+    iteration (callback.py:100-129). Values are lists (indexed by round)
+    or functions of the current round."""
+
+    def callback(env):
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        "Length of list {} has to equal to 'num_boost_round'."
+                        .format(repr(key)))
+                env.model.reset_parameter(
+                    {key: value[env.iteration - env.begin_iteration]})
+            else:
+                env.model.reset_parameter(
+                    {key: value(env.iteration - env.begin_iteration)})
+    callback.before_iteration = True
+    callback.order = 10
+    return callback
+
+
+def early_stopping(stopping_rounds, verbose=True):
+    """Stop when no validation metric improved in `stopping_rounds`
+    rounds (callback.py:132-192). Checks ALL metrics of all valid sets."""
+    factor_to_bigger_better = {}
+    best_score = {}
+    best_iter = {}
+    best_msg = {}
+
+    def init(env):
+        if not env.evaluation_result_list:
+            raise ValueError("For early stopping, at least one dataset or "
+                             "eval metric is required for evaluation")
+        if verbose:
+            print("Train until valid scores didn't improve in {} rounds."
+                  .format(stopping_rounds))
+        for i, ret in enumerate(env.evaluation_result_list):
+            best_score[i] = float("-inf")
+            best_iter[i] = 0
+            best_msg[i] = ""
+            factor_to_bigger_better[i] = 1.0 if ret[3] else -1.0
+
+    def callback(env):
+        if not best_score:
+            init(env)
+        for i, ret in enumerate(env.evaluation_result_list):
+            score = ret[2] * factor_to_bigger_better[i]
+            if score > best_score[i]:
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                if verbose:
+                    best_msg[i] = "[%d]\t%s" % (
+                        env.iteration + 1,
+                        "\t".join(_format_eval_result(x)
+                                  for x in env.evaluation_result_list))
+            elif env.iteration - best_iter[i] >= stopping_rounds:
+                if env.model is not None:
+                    env.model.set_attr(best_iteration=str(best_iter[i]))
+                if verbose:
+                    print("Early stopping, best iteration is:")
+                    print(best_msg[i])
+                raise EarlyStopException(best_iter[i])
+    callback.order = 30
+    return callback

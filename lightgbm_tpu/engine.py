@@ -1,2 +1,261 @@
-def train(*a, **k): raise NotImplementedError
-def cv(*a, **k): raise NotImplementedError
+"""Training entry points: `train` and `cv`.
+
+Reference: python-package/lightgbm/engine.py:12-395. Same control flow:
+predictor chaining for init_model, valid-set reference alignment,
+callback orchestration (before/after each iteration, ordered), early
+stopping via EarlyStopException, and n-fold CV built on Dataset.subset
+with mean/std aggregation.
+"""
+
+import collections
+from operator import attrgetter
+
+import numpy as np
+
+from . import callback
+from .basic import Booster, Dataset, LightGBMError, _InnerPredictor, is_str
+
+
+def _configure_callbacks(callbacks):
+    """Normalize user callbacks: default ordering, split into before/after
+    iteration groups, sorted by `.order` (engine.py:124-150)."""
+    if callbacks is None:
+        callbacks = set()
+    else:
+        for i, cb in enumerate(callbacks):
+            cb.__dict__.setdefault("order", i - len(callbacks))
+        callbacks = set(callbacks)
+    return callbacks
+
+
+def _split_callbacks(callbacks):
+    before = {cb for cb in callbacks if getattr(cb, "before_iteration", False)}
+    after = callbacks - before
+    return (sorted(before, key=attrgetter("order")),
+            sorted(after, key=attrgetter("order")))
+
+
+def train(params, train_set, num_boost_round=100,
+          valid_sets=None, valid_names=None,
+          fobj=None, feval=None, init_model=None,
+          feature_name=None, categorical_feature=None,
+          early_stopping_rounds=None, evals_result=None,
+          verbose_eval=True, learning_rates=None, callbacks=None):
+    """Train one booster (engine.py:12-191). Returns the Booster with
+    `best_iteration` set when early stopping fired."""
+    if is_str(init_model):
+        predictor = _InnerPredictor(model_file=init_model)
+    elif isinstance(init_model, Booster):
+        predictor = init_model._to_predictor()
+    else:
+        predictor = None
+    init_iteration = predictor.num_total_iteration if predictor is not None else 0
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+    train_set._set_predictor(predictor)
+    train_set.set_feature_name(feature_name)
+    train_set.set_categorical_feature(categorical_feature)
+
+    is_valid_contain_train = False
+    train_data_name = "training"
+    reduced_valid_sets = []
+    name_valid_sets = []
+    if valid_sets is not None:
+        if isinstance(valid_sets, Dataset):
+            valid_sets = [valid_sets]
+        if isinstance(valid_names, str):
+            valid_names = [valid_names]
+        for i, valid_data in enumerate(valid_sets):
+            if valid_data is train_set:
+                is_valid_contain_train = True
+                if valid_names is not None:
+                    train_data_name = valid_names[i]
+                continue
+            if not isinstance(valid_data, Dataset):
+                raise TypeError("Training only accepts Dataset object")
+            valid_data.set_reference(train_set)
+            reduced_valid_sets.append(valid_data)
+            if valid_names is not None and len(valid_names) > i:
+                name_valid_sets.append(valid_names[i])
+            else:
+                name_valid_sets.append("valid_" + str(i))
+
+    callbacks = _configure_callbacks(callbacks)
+    if verbose_eval is True:
+        callbacks.add(callback.print_evaluation())
+    elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
+        callbacks.add(callback.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None:
+        callbacks.add(callback.early_stopping(
+            early_stopping_rounds, verbose=bool(verbose_eval)))
+    if learning_rates is not None:
+        callbacks.add(callback.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        callbacks.add(callback.record_evaluation(evals_result))
+    callbacks_before_iter, callbacks_after_iter = _split_callbacks(callbacks)
+
+    booster = Booster(params=params, train_set=train_set)
+    if is_valid_contain_train:
+        booster.set_train_data_name(train_data_name)
+    for valid_set, name_valid_set in zip(reduced_valid_sets, name_valid_sets):
+        booster.add_valid(valid_set, name_valid_set)
+
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in callbacks_before_iter:
+            cb(callback.CallbackEnv(model=booster, cvfolds=None, iteration=i,
+                                    begin_iteration=init_iteration,
+                                    end_iteration=init_iteration + num_boost_round,
+                                    evaluation_result_list=None))
+        booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_sets is not None:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after_iter:
+                cb(callback.CallbackEnv(model=booster, cvfolds=None, iteration=i,
+                                        begin_iteration=init_iteration,
+                                        end_iteration=init_iteration + num_boost_round,
+                                        evaluation_result_list=evaluation_result_list))
+        except callback.EarlyStopException:
+            break
+    if booster.attr("best_iteration") is not None:
+        booster.best_iteration = int(booster.attr("best_iteration")) + 1
+    else:
+        booster.best_iteration = num_boost_round
+    return booster
+
+
+class CVBooster:
+    """One fold of CV (engine.py:194-209)."""
+
+    def __init__(self, train_set, valid_test, params):
+        self.train_set = train_set
+        self.valid_test = valid_test
+        self.booster = Booster(params=params, train_set=train_set)
+        self.booster.add_valid(valid_test, "valid")
+
+    def update(self, fobj):
+        self.booster.update(fobj=fobj)
+
+    def eval(self, feval):
+        return self.booster.eval_valid(feval)
+
+
+def _make_n_folds(full_data, nfold, params, seed, fpreproc=None,
+                  stratified=False, shuffle=True):
+    """engine.py:221-249."""
+    np.random.seed(seed)
+    if stratified:
+        try:
+            from sklearn.model_selection import StratifiedKFold
+        except ImportError:
+            raise LightGBMError("Scikit-learn is required for stratified cv")
+        sfk = StratifiedKFold(n_splits=nfold, shuffle=shuffle, random_state=seed)
+        idset = [x[1] for x in sfk.split(X=full_data.get_label(),
+                                         y=full_data.get_label())]
+    else:
+        full_data.construct()
+        n = full_data.num_data()
+        randidx = np.random.permutation(n) if shuffle else np.arange(n)
+        kstep = int(len(randidx) / nfold)
+        idset = [randidx[(i * kstep): min(len(randidx), (i + 1) * kstep)]
+                 for i in range(nfold)]
+
+    ret = []
+    for k in range(nfold):
+        train_set = full_data.subset(
+            np.concatenate([idset[i] for i in range(nfold) if k != i]))
+        valid_set = full_data.subset(idset[k])
+        if fpreproc is not None:
+            train_set, valid_set, tparam = fpreproc(train_set, valid_set,
+                                                    params.copy())
+        else:
+            tparam = params
+        ret.append(CVBooster(train_set, valid_set, tparam))
+    return ret
+
+
+def _agg_cv_result(raw_results):
+    """engine.py:251-261."""
+    cvmap = collections.defaultdict(list)
+    metric_type = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            metric_type[one_line[1]] = one_line[3]
+            cvmap[one_line[1]].append(one_line[2])
+    return [("cv_agg", k, np.mean(v), metric_type[k], np.std(v))
+            for k, v in cvmap.items()]
+
+
+def cv(params, train_set, num_boost_round=10, nfold=5, stratified=False,
+       shuffle=True, metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name=None, categorical_feature=None,
+       early_stopping_rounds=None, fpreproc=None,
+       verbose_eval=None, show_stdv=True, seed=0, callbacks=None):
+    """Cross-validation (engine.py:263-395). Returns a dict
+    {metric-mean: [...], metric-stdv: [...]}."""
+    if not isinstance(train_set, Dataset):
+        raise TypeError("Training only accepts Dataset object")
+
+    if is_str(init_model):
+        predictor = _InnerPredictor(model_file=init_model)
+    elif isinstance(init_model, Booster):
+        predictor = init_model._to_predictor()
+    else:
+        predictor = None
+    train_set._set_predictor(predictor)
+    train_set.set_feature_name(feature_name)
+    train_set.set_categorical_feature(categorical_feature)
+
+    params = dict(params)
+    if metrics:
+        existing = params.get("metric", []) or []
+        metric_list = existing.split(",") if is_str(existing) else list(existing)
+        if is_str(metrics):
+            metric_list.append(metrics)
+        else:
+            metric_list.extend(metrics)
+        params["metric"] = metric_list
+
+    results = collections.defaultdict(list)
+    cvfolds = _make_n_folds(train_set, nfold, params, seed, fpreproc,
+                            stratified, shuffle)
+
+    callbacks = _configure_callbacks(callbacks)
+    if early_stopping_rounds is not None:
+        callbacks.add(callback.early_stopping(early_stopping_rounds,
+                                              verbose=False))
+    if verbose_eval is True:
+        callbacks.add(callback.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
+        callbacks.add(callback.print_evaluation(verbose_eval,
+                                                show_stdv=show_stdv))
+    callbacks_before_iter, callbacks_after_iter = _split_callbacks(callbacks)
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before_iter:
+            cb(callback.CallbackEnv(model=None, cvfolds=cvfolds, iteration=i,
+                                    begin_iteration=0,
+                                    end_iteration=num_boost_round,
+                                    evaluation_result_list=None))
+        for fold in cvfolds:
+            fold.update(fobj)
+        res = _agg_cv_result([f.eval(feval) for f in cvfolds])
+        for _, key, mean, _, std in res:
+            results[key + "-mean"].append(mean)
+            results[key + "-stdv"].append(std)
+        try:
+            for cb in callbacks_after_iter:
+                cb(callback.CallbackEnv(model=None, cvfolds=cvfolds, iteration=i,
+                                        begin_iteration=0,
+                                        end_iteration=num_boost_round,
+                                        evaluation_result_list=res))
+        except callback.EarlyStopException as e:
+            for k in results:
+                results[k] = results[k][:e.best_iteration + 1]
+            break
+    return dict(results)

@@ -74,7 +74,7 @@ class _BinaryMetric(Metric):
 
 
 class BinaryLoglossMetric(_BinaryMetric):
-    names = ("binary_logloss",)
+    names = ("logloss",)  # display name per binary_metric.hpp:119
 
     def eval(self, score):
         p = np.clip(self._prob(score), K_EPSILON, 1.0 - K_EPSILON)
@@ -83,7 +83,7 @@ class BinaryLoglossMetric(_BinaryMetric):
 
 
 class BinaryErrorMetric(_BinaryMetric):
-    names = ("binary_error",)
+    names = ("error",)  # display name per binary_metric.hpp:138
 
     def eval(self, score):
         p = self._prob(score)

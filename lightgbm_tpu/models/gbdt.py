@@ -85,10 +85,11 @@ class GBDT:
             self.tree_learner.init(train_data)
             self.training_metrics = list(training_metrics)
             self.train_score_updater = ScoreUpdater(train_data, self.num_class)
-            # replay existing models onto the new data (continued training)
-            for i in range(self.iter + self.num_init_iteration):
+            # replay THIS booster's trees onto the new data; merged init
+            # trees are covered by the dataset's init score (gbdt.cpp:77-79)
+            for i in range(self.iter):
                 for k in range(self.num_class):
-                    t = self.models[i * self.num_class + k]
+                    t = self.models[(i + self.num_init_iteration) * self.num_class + k]
                     self.train_score_updater.add_score_by_tree(t, k)
             self.num_data = train_data.num_data
             self.max_feature_idx = train_data.num_total_features - 1
@@ -106,9 +107,12 @@ class GBDT:
             Log.fatal("cannot add validation data, since it has different bin "
                       "mappers with training data")
         updater = ScoreUpdater(valid_data, self.num_class)
-        for i in range(self.iter + self.num_init_iteration):
+        # only this booster's own trees: merged init trees are covered by
+        # the valid set's init score (gbdt.cpp:125-129)
+        for i in range(self.iter):
             for k in range(self.num_class):
-                updater.add_score_by_tree(self.models[i * self.num_class + k], k)
+                idx = (i + self.num_init_iteration) * self.num_class + k
+                updater.add_score_by_tree(self.models[idx], k)
         self.valid_score_updaters.append(updater)
         self.valid_metrics.append(list(valid_metrics))
         if self.early_stopping_round > 0:

@@ -1,0 +1,104 @@
+"""Port of the reference sklearn test suite (tests/python_package_test/
+test_sklearn.py). load_boston is gone from modern sklearn; regression
+thresholds are recalibrated for load_diabetes (see test_engine_api.py).
+"""
+
+import numpy as np
+from sklearn.base import clone
+from sklearn.datasets import load_breast_cancer, load_diabetes, load_digits
+from sklearn.metrics import log_loss, mean_squared_error
+from sklearn.model_selection import GridSearchCV, train_test_split
+
+import lightgbm_tpu as lgb
+
+FIT_KW = dict(verbose=False)
+
+
+def run_template(X_y=None, model=lgb.LGBMRegressor, feval=mean_squared_error,
+                 stratify=None, num_round=60, return_data=False,
+                 return_model=False, custom_obj=None, proba=False):
+    if X_y is None:
+        X_y = load_diabetes(return_X_y=True)
+    X, y = X_y
+    X_train, X_test, y_train, y_test = train_test_split(
+        X, y, test_size=0.1, stratify=stratify, random_state=42)
+    if return_data:
+        return X_train, X_test, y_train, y_test
+    kwargs = dict(n_estimators=num_round, min_child_samples=10)
+    if custom_obj:
+        kwargs["objective"] = custom_obj
+    gbm = model(**kwargs)
+    gbm.fit(X_train, y_train, eval_set=[(X_test, y_test)],
+            early_stopping_rounds=10, verbose=False)
+    if return_model:
+        return gbm
+    return feval(y_test, gbm.predict_proba(X_test) if proba
+                 else gbm.predict(X_test))
+
+
+def test_binary():
+    X_y = load_breast_cancer(return_X_y=True)
+    ret = run_template(X_y, lgb.LGBMClassifier, log_loss, stratify=X_y[1],
+                       proba=True)
+    assert ret < 0.15
+
+
+def test_regression():
+    assert run_template() ** 0.5 < 60
+
+
+def test_multiclass():
+    X_y = load_digits(n_class=10, return_X_y=True)
+
+    def multi_error(y_true, y_pred):
+        return np.mean(y_true != y_pred)
+    ret = run_template(X_y, lgb.LGBMClassifier, multi_error, stratify=X_y[1])
+    assert ret < 0.2
+
+
+def test_regression_with_custom_objective():
+    def objective_ls(y_true, y_pred):
+        grad = (y_pred - y_true)
+        hess = np.ones(len(y_true))
+        return grad, hess
+    ret = run_template(custom_obj=objective_ls)
+    assert ret < 10000
+
+
+def test_binary_classification_with_custom_objective():
+    def logregobj(y_true, y_pred):
+        y_pred = 1.0 / (1.0 + np.exp(-y_pred))
+        grad = y_pred - y_true
+        hess = y_pred * (1.0 - y_pred)
+        return grad, hess
+    X_y = load_digits(n_class=2, return_X_y=True)
+
+    def binary_error(y_test, y_pred):
+        return np.mean([int(p > 0.5) != y for y, p in zip(y_test, y_pred)])
+    ret = run_template(X_y, lgb.LGBMClassifier, feval=binary_error,
+                       custom_obj=logregobj)
+    assert ret < 0.1
+
+
+def test_lambdarank():
+    rng = np.random.RandomState(7)
+    n_q, per_q, f = 30, 12, 5
+    X = rng.rand(n_q * per_q, f)
+    relevance = (X[:, 0] * 3).astype(int).clip(0, 3)
+    group = np.full(n_q, per_q)
+    model = lgb.LGBMRanker(n_estimators=10, min_child_samples=5)
+    model.fit(X, relevance, group=group, eval_at=[1], verbose=False)
+    assert model.booster().current_iteration() == 10
+
+
+def test_grid_search():
+    X_train, X_test, y_train, y_test = run_template(return_data=True)
+    params = {"n_estimators": [10, 15, 20]}
+    gbm = GridSearchCV(lgb.LGBMRegressor(min_child_samples=10), params, cv=3)
+    gbm.fit(X_train, y_train)
+    assert gbm.best_params_["n_estimators"] in [10, 15, 20]
+
+
+def test_clone():
+    gbm = run_template(return_model=True)
+    clone(gbm)
