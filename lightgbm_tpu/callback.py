@@ -55,14 +55,15 @@ def record_evaluation(eval_result):
     eval_result.clear()
 
     def init(env):
-        for data_name, _, _, _ in env.evaluation_result_list:
-            eval_result.setdefault(data_name, collections.defaultdict(list))
+        for item in env.evaluation_result_list:
+            eval_result.setdefault(item[0], collections.defaultdict(list))
 
     def callback(env):
         if not eval_result:
             init(env)
-        for data_name, eval_name, result, _ in env.evaluation_result_list:
-            eval_result[data_name][eval_name].append(result)
+        # items are 4-tuples from train() and 5-tuples (+stdv) from cv()
+        for item in env.evaluation_result_list:
+            eval_result[item[0]][item[1]].append(item[2])
     callback.order = 20
     return callback
 

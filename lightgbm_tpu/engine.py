@@ -69,7 +69,7 @@ def train(params, train_set, num_boost_round=100,
         for i, valid_data in enumerate(valid_sets):
             if valid_data is train_set:
                 is_valid_contain_train = True
-                if valid_names is not None:
+                if valid_names is not None and len(valid_names) > i:
                     train_data_name = valid_names[i]
                 continue
             if not isinstance(valid_data, Dataset):
@@ -125,6 +125,9 @@ def train(params, train_set, num_boost_round=100,
     if booster.attr("best_iteration") is not None:
         booster.best_iteration = int(booster.attr("best_iteration")) + 1
     else:
+        # reference quirk kept (engine.py:190): without early stopping this
+        # is num_boost_round, NOT init_iteration + num_boost_round — under
+        # continued training predict(best_iteration) then truncates
         booster.best_iteration = num_boost_round
     return booster
 
@@ -161,6 +164,8 @@ def _make_n_folds(full_data, nfold, params, seed, fpreproc=None,
         full_data.construct()
         n = full_data.num_data()
         randidx = np.random.permutation(n) if shuffle else np.arange(n)
+        # reference quirk kept (engine.py:236-237): the last n % nfold rows
+        # of the permutation appear in no fold
         kstep = int(len(randidx) / nfold)
         idset = [randidx[(i * kstep): min(len(randidx), (i + 1) * kstep)]
                  for i in range(nfold)]

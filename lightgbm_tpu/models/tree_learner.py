@@ -2,25 +2,36 @@
 
 Reference: src/treelearner/serial_tree_learner.cpp:19-442 (leaf-wise loop),
 src/treelearner/data_partition.hpp (row->leaf partition),
-src/treelearner/leaf_splits.hpp (per-leaf state).
+src/treelearner/leaf_splits.hpp (per-leaf state),
+src/treelearner/feature_histogram.hpp:97-106 (subtraction trick).
 
 TPU-first design (diverges deliberately from the C++ class graph):
 
-- The reference splits one leaf at a time with an LRU histogram pool,
-  ordered-gradient gathers and index-list partitions — all CPU-cache
-  tricks. Here the entire tree grows inside one jitted
-  `lax.fori_loop`: static shapes, no host round-trips per split.
-- DataPartition becomes a dense (N,) int32 `row_leaf` map updated with
-  `where(bin <= threshold)` — no index lists, no dynamic shapes.
-- Histograms for BOTH children of the split leaf are built in one
-  masked one-hot matmul over all rows (ops/histogram.py); the
-  histogram pool and the subtraction trick are unnecessary in this
-  formulation (the stat columns share one MXU pass), so per-leaf
-  histogram state is O(num_leaves) split records only.
-- Collectives are injected through `psum_fn`, so the data-parallel
-  learner (parallel/learners.py) reuses this exact builder with
-  `lax.psum` inside `shard_map` — the same structure as the reference
-  where DataParallelTreeLearner subclasses SerialTreeLearner.
+- The entire tree grows inside one jitted `lax.fori_loop`: static
+  shapes, no host round-trips per split.
+- The row partition is kept BOTH as a dense (N,) `row_leaf` map (for the
+  score updater) and as an `ord_idx` index array grouped by leaf into
+  contiguous segments with `leaf_start`/`leaf_rows` — the analog of the
+  reference's DataPartition, maintained by a stable cumsum compaction
+  (data_partition.hpp:90-140 does the same with per-thread buffers).
+- Histograms: only the SMALLER child is computed per split; the larger
+  child is parent − smaller from a per-leaf (L, F, B, 3) histogram
+  cache (the subtraction trick; the reference's LRU HistogramPool
+  becomes a fixed HBM buffer — 63 leaves × 28 feat × 256 bins × 3
+  stats ≈ 5 MB for the HIGGS shape).
+- The smaller child's rows are gathered from its `ord_idx` segment into
+  one of a few SIZE-BUCKETED static buffers (N/2, N/4, ... rounded to
+  the scan chunk) chosen with `lax.switch`, then reduced with the
+  one-hot MXU contraction (ops/histogram.py). This keeps every shape
+  static while making per-split cost proportional to the (bucketed)
+  leaf size instead of O(N) — the reason the reference partitions rows
+  at all.
+- Collectives are injected through hooks so the parallel learners
+  (parallel/learners.py) reuse this exact builder under `shard_map`:
+  `hist_psum_fn` reduces histograms across row shards (the reference's
+  ReduceScatter sync point), `sum_psum_fn` reduces root sums, and
+  `evaluate_fn`/`split_col_fn` override split search and split-column
+  fetch for the feature-parallel / voting learners.
 
 Split semantics (gain formulas, epsilons, tie-breaks, max_depth guard,
 min_data/min_sum_hessian constraints) follow the reference exactly; see
@@ -40,15 +51,34 @@ from ..utils.log import Log
 from .tree import Tree
 
 
-def _identity_psum(x):
+def _identity(x):
     return x
+
+
+def bucket_sizes(n_pad, chunk):
+    """Static gather-buffer sizes: n_pad, ~n_pad/2, ~n_pad/4, ... floor
+    `chunk`, each rounded up to a multiple of `chunk` so the chunked
+    histogram scan stays aligned."""
+    if n_pad <= chunk:
+        return [n_pad]
+    sizes = [n_pad]
+    s = n_pad
+    while True:
+        s = max(chunk, ((s // 2 + chunk - 1) // chunk) * chunk)
+        if s >= sizes[-1]:
+            break
+        sizes.append(s)
+        if s == chunk:
+            break
+    return sizes
 
 
 def build_tree_device(bins, grad, hess, inbag, feature_mask,
                       num_bin_pf, is_cat,
                       *, num_leaves, max_bin, params: SplitParams,
-                      max_depth, row_chunk, psum_fn=_identity_psum,
-                      evaluate_fn=None):
+                      max_depth, row_chunk,
+                      hist_psum_fn=_identity, sum_psum_fn=_identity,
+                      evaluate_fn=None, split_col_fn=None):
     """Grow one leaf-wise tree on device. All shapes static.
 
     Args:
@@ -58,13 +88,17 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
       feature_mask: (F,) bool feature_fraction mask.
       num_bin_pf: (F,) int32 bins per feature; is_cat: (F,) bool.
       num_leaves/max_bin/params/max_depth/row_chunk: static config.
-      psum_fn: explicit collective reduction (shard_map learners); under
-        GSPMD auto-sharding this stays identity and XLA inserts the
-        collectives from the input shardings.
-      evaluate_fn: optional (local_hist3, sum_g, sum_h, cnt) -> SplitInfo
-        override receiving the UN-reduced local histogram — the
-        voting-parallel learner injects its top-k vote + selective psum
-        here (voting_parallel_tree_learner.cpp:137-293).
+      hist_psum_fn: reduces a (F, B, 3) histogram across row shards
+        (identity on a single device / feature-sharded learner).
+      sum_psum_fn: reduces scalar root sums across row shards.
+      evaluate_fn: optional (hist3, sum_g, sum_h, cnt) -> SplitInfo
+        override. `hist3` is the hist_psum_fn-reduced histogram for the
+        serial/data-parallel learners; the voting learner passes
+        hist_psum_fn=identity and does its own selective reduction here
+        (voting_parallel_tree_learner.cpp:137-293).
+      split_col_fn: optional (feature_id) -> (N_pad,) int32 bin column,
+        overridden by the feature-parallel learner to broadcast the
+        owner shard's column.
 
     Returns a dict of tree arrays + the final row->leaf partition.
     """
@@ -73,22 +107,49 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
     b = max_bin
     f32 = jnp.float32
 
-    def hist_fn(ghc):
-        return build_histograms(bins, ghc, b, row_chunk)
-
     if evaluate_fn is None:
         def evaluate_fn(hist3, sum_g, sum_h, cnt):
-            return find_best_split(psum_fn(hist3), sum_g, sum_h, cnt,
+            return find_best_split(hist3, sum_g, sum_h, cnt,
                                    num_bin_pf, is_cat, feature_mask, params)
     scan_leaf = evaluate_fn
 
-    # ---- root ----------------------------------------------------------
+    if split_col_fn is None:
+        def split_col_fn(feat):
+            return jnp.take(bins, feat, axis=0).astype(jnp.int32)
+
     g_in = grad * inbag
     h_in = hess * inbag
-    root_g = psum_fn(jnp.sum(g_in))
-    root_h = psum_fn(jnp.sum(h_in))
-    root_c = psum_fn(jnp.sum(inbag))
-    hist_root = hist_fn(jnp.stack([g_in, h_in, inbag], axis=1))
+
+    # ---- bucketed smaller-child histogram ------------------------------
+    sizes = bucket_sizes(n_pad, row_chunk)
+    sizes_arr = jnp.asarray(sizes, dtype=jnp.int32)
+
+    def seg_hist(size, ord_idx, start, count):
+        """Histogram of rows ord_idx[start : start+count] via a static
+        `size`-row gather (count <= size; excess positions masked)."""
+        start_c = jnp.clip(jnp.minimum(start, n_pad - size), 0)
+        idx = jax.lax.dynamic_slice(ord_idx, (start_c,), (size,))
+        pos = start_c + jnp.arange(size, dtype=jnp.int32)
+        m = ((pos >= start) & (pos < start + count)).astype(f32)
+        ghc = jnp.stack([jnp.take(g_in, idx) * m,
+                         jnp.take(h_in, idx) * m,
+                         jnp.take(inbag, idx) * m], axis=1)
+        sub_bins = jnp.take(bins, idx, axis=1)
+        return build_histograms(sub_bins, ghc, b, min(row_chunk, size))
+
+    hist_branches = [functools.partial(seg_hist, s) for s in sizes]
+
+    def segment_histogram(ord_idx, start, count):
+        bidx = jnp.sum(sizes_arr >= count) - 1
+        return jax.lax.switch(bidx, hist_branches, ord_idx, start, count)
+
+    # ---- root ----------------------------------------------------------
+    root_g = sum_psum_fn(jnp.sum(g_in))
+    root_h = sum_psum_fn(jnp.sum(h_in))
+    root_c = sum_psum_fn(jnp.sum(inbag))
+    hist_root = hist_psum_fn(
+        build_histograms(bins, jnp.stack([g_in, h_in, inbag], axis=1),
+                         b, row_chunk))
     root_split = scan_leaf(hist_root, root_g, root_h, root_c)
 
     def set0(arr, v):
@@ -96,6 +157,12 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
 
     state = {
         "row_leaf": jnp.zeros(n_pad, dtype=jnp.int32),
+        # DataPartition: row indices grouped by leaf + segment table
+        "ord_idx": jnp.arange(n_pad, dtype=jnp.int32),
+        "leaf_start": jnp.zeros(l, dtype=jnp.int32),
+        "leaf_rows": jnp.zeros(l, dtype=jnp.int32).at[0].set(n_pad),
+        # per-leaf histogram cache (HistogramPool, fixed buffer)
+        "hist_cache": jnp.zeros((l, f, b, 3), dtype=f32).at[0].set(hist_root),
         "done": jnp.asarray(False),
         "n_splits": jnp.asarray(0, dtype=jnp.int32),
         # per-leaf split candidates (LeafSplits + best_split_per_leaf_)
@@ -169,27 +236,56 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
                                 .at[right_id].set(st["best_rc"][best_leaf].astype(jnp.int32)))
             st["n_splits"] = st["n_splits"] + 1
 
-            # ---- partition update (DataPartition::Split, data_partition.hpp:90-140)
-            frow = jnp.take(bins, feat, axis=0).astype(jnp.int32)
-            go_left = jnp.where(is_cat[feat], frow == thr, frow <= thr)
+            # ---- partition update (DataPartition::Split)
+            col = split_col_fn(feat)
+            # dense row->leaf map (score updater output)
+            go_left_row = jnp.where(is_cat[feat], col == thr, col <= thr)
             in_leaf = st["row_leaf"] == best_leaf
-            st["row_leaf"] = jnp.where(in_leaf & ~go_left, right_id, st["row_leaf"])
+            st["row_leaf"] = jnp.where(in_leaf & ~go_left_row, right_id,
+                                       st["row_leaf"])
+            # ordered-index stable compaction within the leaf's segment
+            seg_s = st["leaf_start"][best_leaf]
+            seg_n = st["leaf_rows"][best_leaf]
+            pos = jnp.arange(n_pad, dtype=jnp.int32)
+            inseg = (pos >= seg_s) & (pos < seg_s + seg_n)
+            vals = jnp.take(col, st["ord_idx"])
+            go_l = inseg & jnp.where(is_cat[feat], vals == thr, vals <= thr)
+            go_r = inseg & ~go_l
+            cl_rows = jnp.sum(go_l.astype(jnp.int32))
+            lcum = jnp.cumsum(go_l.astype(jnp.int32))
+            rcum = jnp.cumsum(go_r.astype(jnp.int32))
+            newpos = jnp.where(go_l, seg_s + lcum - 1,
+                               jnp.where(go_r, seg_s + cl_rows + rcum - 1, pos))
+            st["ord_idx"] = jnp.zeros_like(st["ord_idx"]).at[newpos].set(st["ord_idx"])
+            st["leaf_start"] = (st["leaf_start"].at[best_leaf].set(seg_s)
+                                .at[right_id].set(seg_s + cl_rows))
+            st["leaf_rows"] = (st["leaf_rows"].at[best_leaf].set(cl_rows)
+                               .at[right_id].set(seg_n - cl_rows))
+
+            # ---- smaller-child histogram + parent subtraction
+            # smaller side by GLOBAL in-bag count (consistent across row
+            # shards; data_parallel_tree_learner.cpp:178-187), bucket by
+            # LOCAL row count (shard-divergent is fine: no collectives
+            # inside the switch)
+            left_is_small = st["best_lc"][best_leaf] <= st["best_rc"][best_leaf]
+            small_start = jnp.where(left_is_small, seg_s, seg_s + cl_rows)
+            small_rows = jnp.where(left_is_small, cl_rows, seg_n - cl_rows)
+            hist_small = hist_psum_fn(
+                segment_histogram(st["ord_idx"], small_start, small_rows))
+            hist_large = st["hist_cache"][best_leaf] - hist_small
+            hist_left = jnp.where(left_is_small, hist_small, hist_large)
+            hist_right = jnp.where(left_is_small, hist_large, hist_small)
+            st["hist_cache"] = (st["hist_cache"].at[best_leaf].set(hist_left)
+                                .at[right_id].set(hist_right))
 
             # ---- children leaf state (LeafSplits::Init after split)
             child_depth = st["leaf_depth"][best_leaf] + 1
             st["leaf_depth"] = (st["leaf_depth"].at[best_leaf].set(child_depth)
                                 .at[right_id].set(child_depth))
 
-            # ---- both children histograms in one masked pass
-            in_l = (st["row_leaf"] == best_leaf).astype(f32) * inbag
-            in_r = (st["row_leaf"] == right_id).astype(f32) * inbag
-            ghc6 = jnp.stack([g_in * in_l, h_in * in_l, in_l,
-                              g_in * in_r, h_in * in_r, in_r], axis=1)
-            hist6 = hist_fn(ghc6)
-
-            lsplit = scan_leaf(hist6[:, :, 0:3], st["best_lg"][best_leaf],
+            lsplit = scan_leaf(hist_left, st["best_lg"][best_leaf],
                                st["best_lh"][best_leaf], st["best_lc"][best_leaf])
-            rsplit = scan_leaf(hist6[:, :, 3:6], st["best_rg"][best_leaf],
+            rsplit = scan_leaf(hist_right, st["best_rg"][best_leaf],
                                st["best_rh"][best_leaf], st["best_rc"][best_leaf])
 
             # max_depth guard (serial_tree_learner.cpp:238-247)
@@ -330,7 +426,7 @@ class SerialTreeLearner:
     def train(self, grad, hess, inbag=None):
         """Grow one tree. grad/hess: (N,) device or host float32.
 
-        Returns (Tree, row_leaf device array of shape (N,)).
+        Returns (Tree, row_leaf device array of shape (N,), leaf_values).
         """
         n, n_pad = self.num_data, self.n_pad
         grad = jnp.asarray(grad, dtype=jnp.float32)

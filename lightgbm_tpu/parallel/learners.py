@@ -7,32 +7,31 @@ The reference's hand-written collectives (Bruck allgather +
 recursive-halving reduce-scatter over TCP/MPI, src/network/) are
 replaced by XLA collectives over ICI/DCN; topology is XLA's problem.
 
-TPU-first design:
+All three learners reuse the SAME jitted tree builder
+(models/tree_learner.py) under `jax.shard_map`, injecting collectives
+at exactly the reference's sync points:
 
-- **Data parallel** (data_parallel_tree_learner.cpp): the reference
-  shards ROWS, builds local histograms, ReduceScatters the histogram
-  bytes, and Allreduce-maxes the best split. Here the SAME jitted tree
-  builder (models/tree_learner.py) is compiled with the row axis of
-  `bins`/`grad`/`hess`/`inbag` sharded over the mesh's "data" axis —
-  GSPMD then inserts the histogram all-reduce at exactly the
-  reference's sync point (the one-hot contraction over the sharded row
-  axis) and every device applies the identical global best split, the
-  same invariant the reference maintains structurally. Global leaf
-  counts come out of the same reduction (the `count` column of the
-  histogram), matching global_data_count_in_leaf_.
+- **Data parallel** (data_parallel_tree_learner.cpp): rows sharded.
+  `hist_psum_fn`/`sum_psum_fn` = `lax.psum` — the analog of the
+  reference's histogram ReduceScatter (:155-157) and root-sum Allreduce
+  (:97-124). Every shard then applies the identical global best split
+  (the invariant the reference maintains structurally); global leaf
+  counts come from the count column of the reduced histogram
+  (global_data_count_in_leaf_, :58-64).
 
-- **Feature parallel** (feature_parallel_tree_learner.cpp): the
-  reference shards FEATURES, keeps all rows everywhere, and
-  Allreduce-maxes 2xSplitInfo. Here `bins` is sharded over features;
-  the per-(feature,bin) scan runs on the owning device and the argmax
-  over the sharded feature axis becomes the collective.
+- **Feature parallel** (feature_parallel_tree_learner.cpp): features
+  sharded, all rows on every device. Each shard evaluates splits on its
+  own features and the global best is an all_gather + argmax of one
+  SplitInfo per shard (the 2×SplitInfo Allreduce-max, :64-72). The
+  split column is broadcast from its owner with a psum (the reference
+  needs no broadcast only because every rank stores ALL features;
+  we shard storage too).
 
-- **Voting parallel** (PV-Tree, voting_parallel_tree_learner.cpp):
-  genuinely algorithmic communication-volume reduction, expressed with
-  explicit collectives under `jax.shard_map`: each device computes local
-  per-feature best gains, takes a local top-k, all_gathers the k ids,
-  votes, and only the winning <=2k features' histograms are psum'd —
-  the direct analog of the reference's selective ReduceScatter.
+- **Voting parallel** (PV-Tree, voting_parallel_tree_learner.cpp): rows
+  sharded, histograms kept LOCAL (hist_psum = identity); the evaluate
+  hook votes on local top-k gains, all_gathers the candidate ids, and
+  only the winning <=2k features' histograms are psum'd — the analog of
+  the selective ReduceScatter (:226-293).
 """
 
 import functools
@@ -43,8 +42,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.tree_learner import SerialTreeLearner, build_tree_device
-from ..ops.split import (SplitParams, per_feature_best, split_info_at,
-                         K_MIN_SCORE)
+from ..ops.split import (SplitParams, find_best_split, per_feature_best,
+                         split_info_at, K_MIN_SCORE)
 from ..utils.log import Log
 
 AXIS = "data"
@@ -58,6 +57,13 @@ def make_mesh(config) -> Mesh:
     if config is not None and getattr(config, "num_machines", 1) > 1:
         n = min(config.num_machines, len(devs))
     return Mesh(np.asarray(devs[:n]), (AXIS,))
+
+
+_TREE_OUT_KEYS = (
+    "n_splits", "row_leaf", "split_feature", "split_threshold_bin",
+    "split_gain", "left_child", "right_child", "leaf_parent", "leaf_value",
+    "leaf_count", "internal_value", "internal_count",
+)
 
 
 class _MeshedTreeLearner(SerialTreeLearner):
@@ -100,9 +106,7 @@ class _MeshedTreeLearner(SerialTreeLearner):
     def _bins_sharding(self):
         if self.shard_features:
             return NamedSharding(self.mesh, P(AXIS, None))
-        if self.shard_rows:
-            return NamedSharding(self.mesh, P(None, AXIS))
-        return None
+        return NamedSharding(self.mesh, P(None, AXIS))
 
     def _rows_sharding(self):
         if self.shard_rows:
@@ -115,21 +119,99 @@ class _MeshedTreeLearner(SerialTreeLearner):
     def _place_rows(self, arr):
         return jax.device_put(arr, self._rows_sharding())
 
+    def _out_specs(self):
+        specs = {k: P() for k in _TREE_OUT_KEYS}
+        if self.shard_rows:
+            specs["row_leaf"] = P(AXIS)
+        return specs
+
 
 class DataParallelTreeLearner(_MeshedTreeLearner):
     """Row-sharded learner (data_parallel_tree_learner.cpp)."""
     name = "data"
     shard_rows = True
 
+    def _make_build_fn(self, cfg, chunk):
+        num_leaves = int(cfg.num_leaves)
+        max_bin = self.max_bin
+        params = self.params
+        max_depth = int(cfg.max_depth)
+        chunk = min(chunk, self.n_pad // self.n_shards)
+        psum = functools.partial(jax.lax.psum, axis_name=AXIS)
+
+        def dp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
+            return build_tree_device(
+                bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
+                num_leaves=num_leaves, max_bin=max_bin, params=params,
+                max_depth=max_depth, row_chunk=chunk,
+                hist_psum_fn=psum, sum_psum_fn=psum)
+
+        wrapped = jax.shard_map(
+            dp_fn, mesh=self.mesh,
+            in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
+                      P(None), P(None), P(None)),
+            out_specs=self._out_specs(), check_vma=False)
+        return jax.jit(wrapped)
+
 
 class FeatureParallelTreeLearner(_MeshedTreeLearner):
     """Feature-sharded learner (feature_parallel_tree_learner.cpp).
     All rows on every device, features split across devices; the
     reference's greedy bin-balanced feature assignment (:28-43) is
-    replaced by GSPMD's block partition of the feature axis."""
+    replaced by a block partition of the feature axis."""
     name = "feature"
     shard_rows = False
     shard_features = True
+
+    def _make_build_fn(self, cfg, chunk):
+        num_leaves = int(cfg.num_leaves)
+        max_bin = self.max_bin
+        params = self.params
+        max_depth = int(cfg.max_depth)
+        f_loc = self.f_pad // self.n_shards
+        chunk = min(chunk, self.n_pad)
+        n_pad = self.n_pad
+
+        def fp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
+                  is_cat_full):
+            shard = jax.lax.axis_index(AXIS)
+
+            def evaluate(hist3, sum_g, sum_h, cnt):
+                sp = find_best_split(hist3, sum_g, sum_h, cnt,
+                                     num_bin_pf, is_cat, fmask, params)
+                sp = sp._replace(feature=sp.feature + shard * f_loc)
+                # Allreduce-max of SplitInfo (:64-72): gather one best
+                # per shard, pick max gain; shards are stacked in
+                # axis-index order so the first max has the smallest
+                # global feature id (SplitInfo tie-break)
+                gathered = jax.lax.all_gather(sp, AXIS)
+                widx = jnp.argmax(gathered.gain)
+                return jax.tree_util.tree_map(lambda x: x[widx], gathered)
+
+            def split_col(feat):
+                lo = shard * f_loc
+                owned = (feat >= lo) & (feat < lo + f_loc)
+                local_feat = jnp.clip(feat - lo, 0, f_loc - 1)
+                col = jnp.take(bins, local_feat, axis=0).astype(jnp.int32)
+                # broadcast the owner's column (zero elsewhere)
+                return jax.lax.psum(jnp.where(owned, col, 0), AXIS)
+
+            return build_tree_device(
+                bins, grad, hess, inbag, fmask, num_bin_pf, is_cat_full,
+                num_leaves=num_leaves, max_bin=max_bin, params=params,
+                max_depth=max_depth, row_chunk=chunk,
+                evaluate_fn=evaluate, split_col_fn=split_col)
+
+        def wrapped7(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
+            inner = jax.shard_map(
+                fp_fn, mesh=self.mesh,
+                in_specs=(P(AXIS, None), P(None), P(None), P(None),
+                          P(AXIS), P(AXIS), P(AXIS), P(None)),
+                out_specs=self._out_specs(), check_vma=False)
+            return inner(bins, grad, hess, inbag, fmask, num_bin_pf,
+                         is_cat, is_cat)
+
+        return jax.jit(wrapped7)
 
 
 class VotingParallelTreeLearner(_MeshedTreeLearner):
@@ -147,24 +229,21 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
         f = self.num_features
         top_k = min(top_k, f)
         sel_k = min(2 * top_k, f)
-        n_local = self.n_pad // self.n_shards
-        chunk = min(chunk, n_local)
-        mesh = self.mesh
+        chunk = min(chunk, self.n_pad // self.n_shards)
         # local vote constraints scaled by 1/num_machines
         # (voting_parallel_tree_learner.cpp:52-54)
         local_params = params._replace(
             min_data_in_leaf=params.min_data_in_leaf / self.n_shards,
             min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / self.n_shards)
+        psum = functools.partial(jax.lax.psum, axis_name=AXIS)
 
         def voting_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
-            psum = functools.partial(jax.lax.psum, axis_name=AXIS)
-
             def evaluate(hist3, sum_g, sum_h, cnt):
                 # local per-feature best gains from LOCAL leaf sums (the
                 # reference votes on machine-local smaller_leaf_splits_,
-                # :86,231; global sums are only for the final pick). Any one
-                # feature's bins partition the local rows, so feature 0's
-                # bin sums ARE the local leaf totals.
+                # :86,231; global sums are only for the final pick). Any
+                # one feature's bins partition the local rows, so feature
+                # 0's bin sums ARE the local leaf totals.
                 local_g = jnp.sum(hist3[0, :, 0])
                 local_h = jnp.sum(hist3[0, :, 1])
                 local_c = jnp.sum(hist3[0, :, 2])
@@ -198,20 +277,11 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
-                psum_fn=psum, evaluate_fn=evaluate)
+                sum_psum_fn=psum, evaluate_fn=evaluate)
 
-        out_specs = {k: P() for k in _TREE_OUT_KEYS}
-        out_specs["row_leaf"] = P(AXIS)
         wrapped = jax.shard_map(
-            voting_fn, mesh=mesh,
+            voting_fn, mesh=self.mesh,
             in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
                       P(None), P(None), P(None)),
-            out_specs=out_specs, check_vma=False)
+            out_specs=self._out_specs(), check_vma=False)
         return jax.jit(wrapped)
-
-
-_TREE_OUT_KEYS = (
-    "n_splits", "row_leaf", "split_feature", "split_threshold_bin",
-    "split_gain", "left_child", "right_child", "leaf_parent", "leaf_value",
-    "leaf_count", "internal_value", "internal_count",
-)

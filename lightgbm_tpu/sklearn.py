@@ -150,13 +150,12 @@ class LGBMModel(LGBMModelBase):
         params = self.get_params()
         params["verbose"] = 0 if self.silent else 1
 
-        if other_params is not None:
-            params.update(other_params)
-
         if self.fobj:
             params["objective"] = "none"
         else:
             params["objective"] = self.objective
+        if other_params is not None:
+            params.update(other_params)
         # sklearn's get_params returns the estimator's constructor kwargs;
         # drop the ones that are not native training parameters
         params.pop("n_estimators", None)
@@ -279,7 +278,11 @@ class LGBMClassifier(LGBMModel, LGBMClassifierBase):
         if other_params is None:
             other_params = {}
         if self.n_classes_ > 2:
-            self.objective = "multiclass"
+            # the reference mutates self.objective here (sklearn.py:512),
+            # which breaks refitting the same estimator on binary data;
+            # pass the override through params instead
+            if self.fobj is None:
+                other_params["objective"] = "multiclass"
             other_params["num_class"] = self.n_classes_
             if eval_set is not None and eval_metric == "binary_logloss":
                 eval_metric = "multi_logloss"
