@@ -1,0 +1,207 @@
+"""CLI application: train / predict lifecycle.
+
+Reference: include/LightGBM/application.h:25-87,
+src/application/application.cpp, src/application/predictor.hpp,
+src/main.cpp. Same parameter layering (command line overrides config
+file, application.cpp:46-104), same data-loading order (train set with
+its metrics, then aligned valid sets, application.cpp:106-184), the
+same training loop with per-iteration timing (application.cpp:222-238)
+and the same predict-to-TSV output (predictor.hpp:82-130).
+
+The reference's Network::Init TCP/MPI handshake (application.cpp:189)
+has no analog: parallel learners run on the JAX mesh, so
+`num_machines`/`machine_list_file` select mesh width instead of opening
+sockets.
+"""
+
+import time
+
+import numpy as np
+
+from .config import Config, load_config_file, str2map
+from .io.dataset import DatasetLoader
+from .metrics import create_metric
+from .models.gbdt import create_boosting
+from .objectives import create_objective
+from .utils.log import Log
+
+
+class Predictor:
+    """Batch prediction from a parsed data file (predictor.hpp:24-155).
+    Also provides the init-score hook used for continued training
+    (application.cpp:108-115)."""
+
+    def __init__(self, boosting, is_raw_score=False, is_predict_leaf_index=False,
+                 num_iteration=-1):
+        self.boosting = boosting
+        self.is_raw_score = is_raw_score
+        self.is_predict_leaf_index = is_predict_leaf_index
+        self.num_iteration = num_iteration
+
+    def predict_matrix(self, feats):
+        if self.is_predict_leaf_index:
+            return self.boosting.predict_leaf_index(feats, self.num_iteration)
+        if self.is_raw_score:
+            return self.boosting.predict_raw(feats, self.num_iteration)
+        return self.boosting.predict(feats, self.num_iteration)
+
+    def predict_file(self, data_filename, result_filename, has_header=False,
+                     label_column=""):
+        from .io.parser import parse_text_file
+        _, feats, _, _, _ = parse_text_file(
+            data_filename, has_header=has_header, label_column=label_column)
+        out = np.atleast_2d(self.predict_matrix(feats))
+        with open(result_filename, "w") as fout:
+            for row in out:
+                fout.write("\t".join(f"{v:g}" for v in np.atleast_1d(row)) + "\n")
+        Log.info("Finished prediction and saved result to %s",
+                 str(result_filename))
+
+    def init_score_fun(self):
+        """PredictFunction used by DatasetLoader to seed init scores from a
+        loaded model during continued training."""
+
+        def predict_fun(ds):
+            if ds.raw_data is None:
+                Log.fatal("Cannot compute init scores without raw data")
+            raw = self.boosting.predict_raw(ds.raw_data, self.num_iteration)
+            return raw.T.reshape(-1)  # class-major flat
+        return predict_fun
+
+
+class Application:
+    """CLI lifecycle (application.h:25-87)."""
+
+    def __init__(self, argv):
+        params = self._load_parameters(argv)
+        self.config = Config.from_params(params)
+        self.boosting = None
+        self.objective = None
+        self.train_data = None
+        self.valid_datas = []
+        self.train_metrics = []
+        self.valid_metrics = []
+
+    @staticmethod
+    def _load_parameters(argv):
+        """Command line `k=v` tokens override config-file entries
+        (application.cpp:46-104)."""
+        cmd_params = str2map(" ".join(argv))
+        params = {}
+        config_path = cmd_params.get("config_file", "")
+        if config_path:
+            params.update(load_config_file(config_path))
+        params.update(cmd_params)
+        params.pop("config_file", None)
+        return params
+
+    def run(self):
+        start = time.time()
+        if self.config.task == "train":
+            self.init_train()
+            self.train()
+        elif self.config.task == "predict":
+            self.init_predict()
+            self.predict()
+        else:
+            Log.fatal("Unknown task: %s", self.config.task)
+        Log.info("Finished, elapsed: %f seconds", time.time() - start)
+
+    # -------------------------------------------------------------- training
+    def init_train(self):
+        cfg = self.config
+        if cfg.is_parallel:
+            Log.info("Parallel training over a %d-device mesh "
+                     "(tree_learner=%s)", cfg.num_machines, cfg.tree_learner)
+        self.boosting = create_boosting(cfg.boosting_type, cfg.input_model)
+        self.objective = create_objective(cfg.objective, cfg)
+        self._load_data()
+        if self.objective is not None:
+            self.objective.init(self.train_data.metadata,
+                                self.train_data.num_data)
+        self.boosting.init(cfg, self.train_data, self.objective,
+                           self.train_metrics)
+        for vd, vm in zip(self.valid_datas, self.valid_metrics):
+            self.boosting.add_valid_dataset(vd, vm)
+        Log.info("Finished initializing training")
+
+    def _load_data(self):
+        """application.cpp:106-184."""
+        cfg = self.config
+        start = time.time()
+        predict_fun = None
+        if cfg.input_model:
+            with open(cfg.input_model) as f:
+                self.boosting.load_model_from_string(f.read())
+            predictor = Predictor(self.boosting, is_raw_score=True)
+            predict_fun = predictor.init_score_fun()
+        loader = DatasetLoader(cfg, predict_fun=predict_fun)
+        self.train_data = loader.load_from_file(cfg.data)
+        if cfg.is_training_metric:
+            for name in cfg.metric:
+                m = create_metric(name, cfg)
+                if m is not None:
+                    m.init(self.train_data.metadata, self.train_data.num_data)
+                    self.train_metrics.append(m)
+        self.valid_datas = []
+        self.valid_metrics = []
+        for vfile in cfg.valid_data:
+            vd = loader.load_from_file_align_with_other_dataset(
+                vfile, self.train_data)
+            self.valid_datas.append(vd)
+            ms = []
+            for name in cfg.metric:
+                m = create_metric(name, cfg)
+                if m is not None:
+                    m.init(vd.metadata, vd.num_data)
+                    ms.append(m)
+            self.valid_metrics.append(ms)
+        Log.info("Finished loading data in %f seconds", time.time() - start)
+
+    def train(self):
+        """application.cpp:222-238."""
+        cfg = self.config
+        start = time.time()
+        for it in range(1, cfg.num_iterations + 1):
+            is_finished = self.boosting.train_one_iter(is_eval=True)
+            Log.info("%f seconds elapsed, finished iteration %d",
+                     time.time() - start, it)
+            if is_finished:
+                break
+        self.boosting.save_model_to_file(-1, cfg.output_model)
+        Log.info("Finished training")
+
+    # ------------------------------------------------------------ prediction
+    def init_predict(self):
+        cfg = self.config
+        if not cfg.input_model:
+            Log.fatal("Please specify the model file for prediction")
+        self.boosting = create_boosting("gbdt", cfg.input_model)
+        with open(cfg.input_model) as f:
+            self.boosting.load_model_from_string(f.read())
+        Log.info("Finished initializing prediction")
+
+    def predict(self):
+        cfg = self.config
+        predictor = Predictor(
+            self.boosting,
+            is_raw_score=cfg.is_predict_raw_score,
+            is_predict_leaf_index=cfg.is_predict_leaf_index,
+            num_iteration=cfg.num_iteration_predict)
+        predictor.predict_file(cfg.data, cfg.output_result,
+                               has_header=cfg.has_header,
+                               label_column=cfg.label_column)
+        Log.info("Finished prediction")
+
+
+def main(argv=None):
+    """src/main.cpp:4-23."""
+    import sys
+    if argv is None:
+        argv = sys.argv[1:]
+    try:
+        Application(argv).run()
+    except Exception as ex:  # main.cpp catches and reports all exceptions
+        Log.warning("Met Exceptions:")
+        Log.warning("%s", str(ex))
+        raise SystemExit(1)

@@ -165,7 +165,10 @@ class DatasetLoader:
     def load_from_file(self, filename, rank=0, num_machines=1) -> CoreDataset:
         cfg = self.config
         bin_path = str(filename) + ".bin"
-        if cfg.enable_load_from_binary_file and os.path.exists(bin_path):
+        # the binary cache stores no raw values, which continued training
+        # needs for init scores — fall back to the text path then
+        use_cache = cfg.enable_load_from_binary_file and self.predict_fun is None
+        if use_cache and os.path.exists(bin_path):
             try:
                 ds = CoreDataset.load_binary(bin_path)
                 Log.info("Loaded binary dataset %s", bin_path)
@@ -194,6 +197,8 @@ class DatasetLoader:
 
         ds = self._construct(feats, names, ignore, categorical, meta)
         ds.label_idx = label_idx
+        if self.predict_fun is not None:
+            ds.raw_data = feats  # continued training needs raw values
         self._attach_init_score(ds)
         if cfg.is_save_binary_file:
             ds.save_binary(bin_path)
@@ -213,6 +218,8 @@ class DatasetLoader:
             meta.set_query(_qid_to_counts(feats[:, group_idx]))
         meta.load_side_files(filename)
         ds = self._bin_with_mappers(feats, train_ds, meta)
+        if self.predict_fun is not None:
+            ds.raw_data = feats
         self._attach_init_score(ds)
         return ds
 

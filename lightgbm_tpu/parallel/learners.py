@@ -136,7 +136,6 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
         max_bin = self.max_bin
         params = self.params
         max_depth = int(cfg.max_depth)
-        chunk = min(chunk, self.n_pad // self.n_shards)
         psum = functools.partial(jax.lax.psum, axis_name=AXIS)
 
         def dp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
@@ -169,8 +168,6 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
         params = self.params
         max_depth = int(cfg.max_depth)
         f_loc = self.f_pad // self.n_shards
-        chunk = min(chunk, self.n_pad)
-        n_pad = self.n_pad
 
         def fp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                   is_cat_full):
@@ -229,7 +226,6 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
         f = self.num_features
         top_k = min(top_k, f)
         sel_k = min(2 * top_k, f)
-        chunk = min(chunk, self.n_pad // self.n_shards)
         # local vote constraints scaled by 1/num_machines
         # (voting_parallel_tree_learner.cpp:52-54)
         local_params = params._replace(

@@ -275,8 +275,7 @@ class LGBMClassifier(LGBMModel, LGBMClassifierBase):
             other_params=None):
         self.classes_ = np.unique(y)
         self.n_classes_ = len(self.classes_)
-        if other_params is None:
-            other_params = {}
+        other_params = {} if other_params is None else dict(other_params)
         if self.n_classes_ > 2:
             # the reference mutates self.objective here (sklearn.py:512),
             # which breaks refitting the same estimator on binary data;
