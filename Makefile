@@ -1,0 +1,31 @@
+# Build system for the native pieces of lightgbm_tpu.
+#
+# Reference: /root/reference/CMakeLists.txt:1-98 builds the CLI binary
+# `lightgbm` plus shared lib `lib_lightgbm.so` (the C API). Here the CLI
+# is `python -m lightgbm_tpu`, so the only native artifact is the C API
+# shim: lib_lightgbm.so embeds CPython and forwards every LGBM_* call to
+# lightgbm_tpu.capi_bridge.
+#
+#   make            -> lib_lightgbm.so (repo root, where find_lib_path looks)
+#   make test-capi  -> build + run the ported C API smoke test
+#   make clean
+
+PYTHON       ?= python3
+PY_INCLUDES  := $(shell $(PYTHON)-config --includes)
+PY_LDFLAGS   := $(shell $(PYTHON)-config --ldflags --embed 2>/dev/null || $(PYTHON)-config --ldflags)
+CXX          ?= g++
+CXXFLAGS     ?= -O2 -std=c++17 -fPIC -Wall
+TARGET       := lib_lightgbm.so
+
+all: $(TARGET)
+
+$(TARGET): src_native/c_api_shim.cpp
+	$(CXX) $(CXXFLAGS) -shared $(PY_INCLUDES) $< -o $@ $(PY_LDFLAGS)
+
+test-capi: $(TARGET)
+	$(PYTHON) -m pytest tests/test_c_api.py -q
+
+clean:
+	rm -f $(TARGET)
+
+.PHONY: all test-capi clean

@@ -373,9 +373,23 @@ class Booster:
             return
         merged = {**self._config_as_params(), **params}
         self.config = Config.from_params(merged)
+        core = self.gbdt.train_data
+        self._reset_objective(core)
         self.gbdt.reset_training_data(
-            self.config, self.gbdt.train_data, self.objective,
+            self.config, core, self.objective,
             self.gbdt.training_metrics)
+
+    def _reset_objective(self, core):
+        """Recreate + re-init the objective against `core`, as the
+        reference's Booster::ResetTrainingData does (c_api.cpp:63-75) —
+        the objective caches label/weight views of the old dataset."""
+        if self.objective is None:
+            return  # custom-objective mode stays custom
+        self.objective = create_objective(self.config.objective, self.config)
+        if self.objective is None:
+            Log.warning("Using self-defined objective function")
+        else:
+            self.objective.init(core.metadata, core.num_data)
 
     def _config_as_params(self):
         from dataclasses import fields as dc_fields
@@ -393,6 +407,7 @@ class Booster:
                                     "use same predictor for these data")
             train_set.construct()
             self.__train_dataset = train_set
+            self._reset_objective(train_set._core)
             self.gbdt.reset_training_data(
                 self.config, train_set._core, self.objective,
                 self._create_metrics(train_set._core))

@@ -282,11 +282,13 @@ def booster_get_eval_names(cb, out_strs_addr):
     """Writes each name into the caller's pre-allocated char* slots
     (the reference python wrapper allocates 255-byte buffers)."""
     names = cb.booster.gbdt.get_eval_names(0)
-    ptrs = (ctypes.c_char_p * max(len(names), 1)).from_address(out_strs_addr)
+    # Read the char** as raw pointer values: indexing a c_char_p array
+    # yields a *copied* bytes object, so memmove through it would write
+    # into the copy, never the caller's buffers.
+    ptrs = (ctypes.c_void_p * max(len(names), 1)).from_address(out_strs_addr)
     for i, name in enumerate(names):
-        dst = ctypes.cast(ptrs[i], ctypes.c_void_p).value
         raw = name.encode() + b"\0"
-        ctypes.memmove(dst, raw, len(raw))
+        ctypes.memmove(ptrs[i], raw, len(raw))
     return len(names)
 
 

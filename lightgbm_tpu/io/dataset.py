@@ -168,14 +168,19 @@ class DatasetLoader:
         # the binary cache stores no raw values, which continued training
         # needs for init scores — fall back to the text path then
         use_cache = cfg.enable_load_from_binary_file and self.predict_fun is None
-        if use_cache and os.path.exists(bin_path):
-            try:
-                ds = CoreDataset.load_binary(bin_path)
-                Log.info("Loaded binary dataset %s", bin_path)
+        # CheckCanLoadFromBin (dataset_loader.cpp:903-940): the data path
+        # may BE a binary cache file, or have a sibling <data>.bin cache.
+        if use_cache:
+            for cand in (str(filename), bin_path):
+                if not os.path.exists(cand):
+                    continue
+                try:
+                    ds = CoreDataset.load_binary(cand)
+                except Exception:
+                    continue  # not a binary cache; fall through
+                Log.info("Loaded binary dataset %s", cand)
                 self._attach_init_score(ds)
                 return ds
-            except Exception:
-                pass  # fall through to text load
 
         label, feats, names, fmt, label_idx = parse_text_file(
             filename, has_header=cfg.has_header, label_column=cfg.label_column)

@@ -108,11 +108,12 @@ int to_handle(PyObject* result, void** out) {
   return 0;
 }
 
-/* Result ignored beyond success/failure. */
+/* Result ignored beyond success/failure.
+ * NOTE: must not touch thread state before PyGILState_Ensure — ctypes
+ * callers release the GIL around the C call, so this thread does not
+ * hold it on entry. */
 int to_status(PyObject* result) {
   if (result == nullptr) return -1;
-  Py_BEGIN_ALLOW_THREADS;  /* no-op scope; DECREF below needs the GIL */
-  Py_END_ALLOW_THREADS;
   PyGILState_STATE st = PyGILState_Ensure();
   Py_DECREF(result);
   PyGILState_Release(st);
