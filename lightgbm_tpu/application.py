@@ -160,14 +160,37 @@ class Application:
 
     def train(self):
         """application.cpp:222-238."""
+        from .utils.timers import TIMERS
         cfg = self.config
+        TIMERS.reset()
+        trace_dir = None
+        if cfg.profile:
+            import jax
+            trace_dir = cfg.profile if isinstance(cfg.profile, str) and \
+                cfg.profile not in ("1", "true") else "/tmp/lightgbm_tpu_trace"
+            jax.profiler.start_trace(trace_dir)
         start = time.time()
-        for it in range(1, cfg.num_iterations + 1):
-            is_finished = self.boosting.train_one_iter(is_eval=True)
-            Log.info("%f seconds elapsed, finished iteration %d",
-                     time.time() - start, it)
-            if is_finished:
-                break
+        try:
+            fused = getattr(self.boosting, "_fused_eligible", None)
+            if fused is not None and fused():
+                # whole boosting block as one device program (gbdt.train_many)
+                self.boosting.train_many(cfg.num_iterations)
+                Log.info("%f seconds elapsed, finished iteration %d (fused)",
+                         time.time() - start, self.boosting.iter)
+            else:
+                for it in range(1, cfg.num_iterations + 1):
+                    is_finished = self.boosting.train_one_iter(is_eval=True)
+                    Log.info("%f seconds elapsed, finished iteration %d",
+                             time.time() - start, it)
+                    if is_finished:
+                        break
+        finally:
+            if trace_dir is not None:
+                import jax
+                jax.profiler.stop_trace()
+                Log.info("Wrote jax.profiler trace to %s", trace_dir)
+        if TIMERS.acc:
+            Log.debug("Per-phase timers:\n%s", TIMERS.report())
         self.boosting.save_model_to_file(-1, cfg.output_model)
         Log.info("Finished training")
 

@@ -217,6 +217,7 @@ class Config:
 
     # TPU-specific knobs (no reference equivalent)
     device_row_chunk: int = 16384  # rows per histogram-matmul chunk
+    profile: str = ""              # jax.profiler trace dir ("1" = default dir)
 
     @classmethod
     def from_params(cls, params) -> "Config":

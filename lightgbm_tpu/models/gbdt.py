@@ -13,17 +13,62 @@ same distribution with a vectorized random-key argpartition instead of
 the O(N) sequential scan.
 """
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..metrics import create_metric
 from ..utils import common
 from ..utils.log import Log
 from ..utils.random import Random
+from ..utils.timers import TIMERS
 from .score_updater import ScoreUpdater
 from .tree import Tree
 from .tree_learner import create_tree_learner
 
 K_MIN_SCORE = -np.inf
+
+
+class LazyTree:
+    """A Tree whose arrays still live on device.
+
+    The training loop appends these WITHOUT pulling anything to host —
+    the only per-iteration synchronization is the scalar n_splits stop
+    check. Any host-side access (serialization, prediction, rollback,
+    DART normalization) materializes a real Tree on first touch via the
+    learner's batched single-transfer conversion.
+    """
+
+    def __init__(self, out, learner, shrink=1.0):
+        # row_leaf is (N_pad,) and already consumed by the score updater;
+        # holding it for every tree would pin O(iter * N) HBM.
+        self._out = {k: v for k, v in out.items() if k != "row_leaf"}
+        self._learner = learner
+        self._shrink = float(shrink)
+        self._tree = None
+
+    @property
+    def num_leaves(self):
+        if self._tree is not None:
+            return self._tree.num_leaves
+        return int(self._out["n_splits"]) + 1
+
+    def shrinkage(self, rate):
+        if self._tree is not None:
+            self._tree.shrinkage(rate)
+        else:
+            self._shrink = self._shrink * float(rate)
+
+    def materialize(self) -> Tree:
+        if self._tree is None:
+            self._tree = self._learner._to_host_tree(self._out, shrink=self._shrink)
+            self._out = None
+        return self._tree
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.materialize(), name)
 
 
 class GBDT:
@@ -76,6 +121,9 @@ class GBDT:
         if objective is not None and objective.name == "binary":
             self.sigmoid = config.sigmoid
 
+        # compiled fused programs bake in the old learner's bins and the
+        # old objective's labels; never reuse them across a reset
+        self._fused_cache = {}
         data_changed = train_data is not None and train_data is not self.train_data
         if data_changed:
             if self.tree_learner is None:
@@ -154,39 +202,160 @@ class GBDT:
         if gradients is None or hessians is None:
             if self.objective is None:
                 Log.fatal("No object function provided")
-            gradients, hessians = self.objective.get_gradients(
-                self._score_for_boosting())
+            with TIMERS.phase("gradients"):
+                gradients, hessians = self.objective.get_gradients(
+                    self._score_for_boosting())
         else:
             gradients = np.asarray(gradients, dtype=np.float32).reshape(
                 self.num_class, self.num_data)
             hessians = np.asarray(hessians, dtype=np.float32).reshape(
                 self.num_class, self.num_data)
-        inbag = self._bagging(self.iter)
+        with TIMERS.phase("bagging"):
+            inbag = self._bagging(self.iter)
+        n = self.num_data
         for k in range(self.num_class):
-            tree, row_leaf, leaf_values = self.tree_learner.train(
-                gradients[k], hessians[k], inbag)
-            if tree.num_leaves <= 1:
+            with TIMERS.phase("build"):
+                out = self.tree_learner.train_device(
+                    gradients[k], hessians[k], inbag)
+            # enqueue ALL device work for this class before the scalar stop
+            # check: train scores via partition gather (covers in-bag AND
+            # out-of-bag rows: the partition is computed over all rows, the
+            # bag mask only gates the histogram statistics), then valid
+            # scores via device bin-space traversal. A 0-split tree makes
+            # every update a no-op (leaf values are all zero), so checking
+            # afterwards is safe.
+            with TIMERS.phase("score_upd"):
+                self.train_score_updater.add_score_by_partition(
+                    out["leaf_value"] * self.shrinkage_rate,
+                    out["row_leaf"][:n], k)
+                for updater in self.valid_score_updaters:
+                    updater.add_score_by_device_tree(out, self.shrinkage_rate, k)
+            tree = LazyTree(out, self.tree_learner, shrink=self.shrinkage_rate)
+            with TIMERS.phase("host_sync"):
+                stopped = tree.num_leaves <= 1  # scalar sync: the only wait
+            if stopped:
                 Log.info("Stopped training because there are no more leafs "
                          "that meet the split requirements.")
                 return True
-            tree.shrinkage(self.shrinkage_rate)
-            # train scores via partition gather (covers in-bag AND out-of-bag
-            # rows: the partition is computed over all rows, the bag mask only
-            # gates the histogram statistics)
-            self.train_score_updater.add_score_by_partition(
-                np.asarray(leaf_values, dtype=np.float32) * self.shrinkage_rate,
-                row_leaf, k)
-            for updater in self.valid_score_updaters:
-                updater.add_score_by_tree(tree, k)
             self.models.append(tree)
         self.iter += 1
         if is_eval:
-            return self.eval_and_check_early_stopping()
+            with TIMERS.phase("eval"):
+                return self.eval_and_check_early_stopping()
         return False
 
     def _score_for_boosting(self):
         """Hook for DART's tree-dropping (dart.hpp GetTrainingScore)."""
         return self.train_score_updater.score
+
+    # ------------------------------------------------- fused multi-iteration
+    # TPU-first: when nothing in an iteration needs the host (no bagging,
+    # no per-iteration metric output, binary/regression with a jitted
+    # gradient), the ENTIRE boosting block — gradients, tree build, score
+    # update — runs as ONE XLA program: a lax.scan over iterations. The
+    # host's only job is to feed the per-iteration feature-fraction masks
+    # (same RNG stream as the sequential path) and pull the stacked tree
+    # arrays once at the end. The reference's C++ hot loop
+    # (gbdt.cpp:210-245) keeps everything in-process; this keeps
+    # everything in-graph.
+
+    def _fused_eligible(self):
+        cfg = self.config
+        if cfg is None or self.objective is None:
+            return False
+        return (type(self).__name__ == "GBDT"
+                and self.num_class == 1
+                and not self.valid_score_updaters
+                and (cfg.metric_freq <= 0 or not self.training_metrics)
+                and self.early_stopping_round <= 0
+                and not (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0)
+                # with a constant feature mask, gradients after an empty
+                # tree are unchanged, so every later tree is empty too and
+                # the post-scan truncation in train_many is exact; a
+                # per-iteration mask would break that invariant
+                and cfg.feature_fraction >= 1.0
+                and getattr(self.objective, "_grad", None) is not None
+                and type(self.tree_learner).__name__ == "SerialTreeLearner")
+
+    def _get_fused_fn(self, num_iters):
+        if not hasattr(self, "_fused_cache"):
+            self._fused_cache = {}
+        learner_shapes = (self.tree_learner.num_data, self.tree_learner.n_pad,
+                          self.tree_learner.f_pad)
+        key = (num_iters, float(self.shrinkage_rate), id(self.tree_learner),
+               learner_shapes, id(self.objective))
+        if key in self._fused_cache:
+            return self._fused_cache[key]
+        learner = self.tree_learner
+        n, n_pad = learner.num_data, learner.n_pad
+        pad = n_pad - n
+        core = learner._build_core
+        grad_fn = self.objective._grad
+        bins = learner._bins
+        nbpf = learner._num_bin_pf
+        iscat = learner._is_cat
+        shrink = jnp.float32(self.shrinkage_rate)
+        inbag = jnp.concatenate([jnp.ones(n, jnp.float32),
+                                 jnp.zeros(pad, jnp.float32)])
+
+        def step(score, fmask):
+            g, h = grad_fn(score)
+            out = core(bins, jnp.pad(g[0], (0, pad)), jnp.pad(h[0], (0, pad)),
+                       inbag, fmask, nbpf, iscat)
+            upd = jnp.take(out["leaf_value"], out["row_leaf"][:n]) * shrink
+            score = score.at[0].add(upd)
+            del out["row_leaf"]  # keep the stacked ys O(iter * num_leaves)
+            return score, out
+
+        def fused(score, fmasks):
+            return jax.lax.scan(step, score, fmasks)
+
+        score = self.train_score_updater.score
+        fmasks = jnp.ones((num_iters, learner.f_pad), dtype=bool)
+        compiled = jax.jit(fused).lower(score, fmasks).compile()
+        self._fused_cache[key] = compiled
+        return compiled
+
+    def warm_up_fused(self, num_iters):
+        """Pre-compile the fused trainer (compile time is not training
+        time, same as the reference's ahead-of-time C++ build)."""
+        if self._fused_eligible():
+            self._get_fused_fn(num_iters)
+            return True
+        return False
+
+    def train_many(self, num_iters):
+        """Train `num_iters` boosting iterations; uses the fused in-graph
+        scan when eligible, else the per-iteration loop. Returns True if
+        training stopped early."""
+        if num_iters <= 0:
+            return False
+        if not self._fused_eligible():
+            for _ in range(num_iters):
+                if self.train_one_iter():
+                    return True
+            return False
+        fn = self._get_fused_fn(num_iters)
+        learner = self.tree_learner
+        fmasks = jnp.asarray(
+            np.stack([learner._sample_features() for _ in range(num_iters)]))
+        final_score, stacked = fn(self.train_score_updater.score, fmasks)
+        self.train_score_updater.score = final_score
+        host = jax.device_get(stacked)  # ONE transfer for the whole block
+        nsp = np.asarray(host["n_splits"])
+        t_eff = int(np.argmax(nsp == 0)) if bool((nsp == 0).any()) else num_iters
+        for t in range(t_eff):
+            tree = learner.host_out_to_tree(
+                {k: v[t] for k, v in host.items()}, shrink=self.shrinkage_rate)
+            self.models.append(tree)
+        self.iter += t_eff
+        if t_eff < num_iters:
+            # iterations after the first empty tree changed nothing (empty
+            # trees add zero score), so state is exactly "stopped at t_eff"
+            Log.info("Stopped training because there are no more leafs "
+                     "that meet the split requirements.")
+            return True
+        return False
 
     def rollback_one_iter(self):
         """gbdt.cpp:247-264. Indexes from the end of the model list so it

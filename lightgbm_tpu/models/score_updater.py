@@ -3,12 +3,57 @@
 Reference: src/boosting/score_updater.hpp:15-85. Scores live on device as
 a (num_class, N) float32 array. Train-set updates use the tree builder's
 final row->leaf partition (a pure gather — the analog of the reference's
-via-partition fast path Tree::AddPredictionToScore(tree_learner)); valid
-sets are traversed in bin space on host.
+via-partition fast path Tree::AddPredictionToScore(tree_learner)).
+
+Valid sets are scored per iteration ON DEVICE by a vectorized bin-space
+tree traversal over the dataset's device bin matrix (the analog of
+Tree::AddPredictionToScore(data), tree.h:211-224, which the reference
+runs OpenMP-parallel inside the hot loop): every row walks the tree in
+lockstep inside a `lax.while_loop` bounded by the realized depth, so a
+training iteration never leaves the device. The host numpy traversal
+remains for re-scoring materialized (loaded) models.
 """
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _traverse_add(score_row, bins_dev, is_cat, split_feature, threshold_bin,
+                  left_child, right_child, leaf_value, n_splits, scale):
+    """score_row + scale * leaf_value[leaf(bins)] for one tree, on device.
+
+    bins_dev: (F, N) int bins; tree arrays as produced by
+    build_tree_device (leaves encoded as ~leaf_index in child arrays).
+    A 0-split tree contributes leaf_value[0] == 0, so it is a no-op.
+    """
+    n = bins_dev.shape[1]
+    node0 = jnp.where(n_splits > 0, 0, -1)
+    node = jnp.full((n,), node0, dtype=jnp.int32)
+
+    def cond(state):
+        i, node = state
+        return jnp.logical_and(i < leaf_value.shape[0] - 1,
+                               jnp.any(node >= 0))
+
+    def body(state):
+        i, node = state
+        nd = jnp.maximum(node, 0)
+        feat = split_feature[nd]
+        fv = jnp.take_along_axis(bins_dev, feat[None, :], axis=0)[0]
+        fv = fv.astype(jnp.int32)
+        thr = threshold_bin[nd]
+        go_left = jnp.where(is_cat[feat], fv == thr, fv <= thr)
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        node = jnp.where(node < 0, node, nxt)
+        return i + 1, node
+
+    _, node = jax.lax.while_loop(cond, body, (jnp.asarray(0, jnp.int32), node))
+    leaf = jnp.where(node < 0, ~node, 0)
+    return score_row + scale * jnp.take(leaf_value, leaf)
+
+
+_traverse_add_jit = jax.jit(_traverse_add)
 
 
 class ScoreUpdater:
@@ -17,6 +62,7 @@ class ScoreUpdater:
         self.num_class = int(num_class)
         n = dataset.num_data
         self.num_data = n
+        self._is_cat_dev = None
         init = dataset.metadata.init_score
         if init is not None:
             if len(init) != n * self.num_class:
@@ -32,8 +78,22 @@ class ScoreUpdater:
         upd = jnp.take(jnp.asarray(leaf_values, dtype=jnp.float32), row_leaf)
         self.score = self.score.at[curr_class].add(upd)
 
+    def add_score_by_device_tree(self, out, scale, curr_class):
+        """Per-iteration valid-set scoring: device bin-space traversal of
+        the builder's raw output dict. No host synchronization."""
+        if self._is_cat_dev is None:
+            self._is_cat_dev = jnp.asarray(self.dataset.feature_is_categorical())
+        new_row = _traverse_add_jit(
+            self.score[curr_class], self.dataset.device_bins(),
+            self._is_cat_dev, out["split_feature"],
+            out["split_threshold_bin"], out["left_child"],
+            out["right_child"],
+            jnp.asarray(out["leaf_value"], dtype=jnp.float32),
+            out["n_splits"], jnp.float32(scale))
+        self.score = self.score.at[curr_class].set(new_row)
+
     def add_score_by_tree(self, tree, curr_class):
-        """Host bin-space traversal (valid sets / re-scoring loaded models)."""
+        """Host bin-space traversal (re-scoring loaded/materialized models)."""
         vals = tree.predict_by_bins(self.dataset.bins).astype(np.float32)
         self.score = self.score.at[curr_class].add(jnp.asarray(vals))
 

@@ -368,6 +368,19 @@ class SerialTreeLearner:
         self._bins = self._place_bins(bins)
         self._num_bin_pf = jnp.asarray(num_bin_pf)
         self._is_cat = jnp.asarray(is_cat)
+        # host-side lookup tables for vectorized device->Tree conversion:
+        # bin -> representative value per feature (Feature::BinToValue) and
+        # the per-feature decision type, so _to_host_tree needs no Python
+        # loop over splits.
+        table = np.zeros((self.num_features, self.max_bin), dtype=np.float64)
+        for i, m in enumerate(train_set.bin_mappers):
+            vals = (m.bin_upper_bound if m.bin_type != 1
+                    else m.bin_2_categorical.astype(np.float64))
+            table[i, :len(vals)] = vals
+        self._bin_value_table = table
+        self._decision_type_host = np.asarray(
+            [1 if m.bin_type == 1 else 0 for m in train_set.bin_mappers],
+            dtype=np.int8)
         self.params = SplitParams(
             min_data_in_leaf=float(cfg.min_data_in_leaf),
             min_sum_hessian_in_leaf=float(cfg.min_sum_hessian_in_leaf),
@@ -395,15 +408,22 @@ class SerialTreeLearner:
     def _place_rows(self, arr):
         return arr
 
-    def _make_build_fn(self, cfg, chunk):
-        return jax.jit(functools.partial(
+    def _make_build_core(self, cfg, chunk):
+        """The un-jitted builder closure — also consumed directly by the
+        fused multi-iteration trainer (models/gbdt.py train_many), which
+        embeds it inside its own scanned program."""
+        return functools.partial(
             build_tree_device,
             num_leaves=int(cfg.num_leaves),
             max_bin=self.max_bin,
             params=self.params,
             max_depth=int(cfg.max_depth),
             row_chunk=chunk,
-        ))
+        )
+
+    def _make_build_fn(self, cfg, chunk):
+        self._build_core = self._make_build_core(cfg, chunk)
+        return jax.jit(self._build_core)
 
     def reset_config(self, config):
         self.config = config
@@ -423,10 +443,13 @@ class SerialTreeLearner:
                 [mask, np.zeros(self.f_pad - self.num_features, bool)])
         return mask
 
-    def train(self, grad, hess, inbag=None):
-        """Grow one tree. grad/hess: (N,) device or host float32.
+    def train_device(self, grad, hess, inbag=None):
+        """Grow one tree entirely on device; NO host synchronization.
 
-        Returns (Tree, row_leaf device array of shape (N,), leaf_values).
+        Returns the raw device output dict of build_tree_device (tree
+        arrays + (N_pad,) row->leaf partition). The caller decides when
+        (and whether) to pull anything to host — see models/gbdt.py
+        LazyTree.
         """
         n, n_pad = self.num_data, self.n_pad
         grad = jnp.asarray(grad, dtype=jnp.float32)
@@ -443,35 +466,49 @@ class SerialTreeLearner:
         hess = self._place_rows(hess)
         inbag = self._place_rows(inbag)
         fmask = jnp.asarray(self._sample_features())
-        out = self._build(self._bins, grad, hess, inbag, fmask,
-                          self._num_bin_pf, self._is_cat)
-        tree = self._to_host_tree(out)
-        return tree, out["row_leaf"][:n], out["leaf_value"]
+        return self._build(self._bins, grad, hess, inbag, fmask,
+                           self._num_bin_pf, self._is_cat)
 
-    def _to_host_tree(self, out) -> Tree:
-        n_splits = int(out["n_splits"])
+    def train(self, grad, hess, inbag=None):
+        """Grow one tree. grad/hess: (N,) device or host float32.
+
+        Returns (Tree, row_leaf device array of shape (N,), leaf_values).
+        """
+        out = self.train_device(grad, hess, inbag)
+        tree = self._to_host_tree(out)
+        return tree, out["row_leaf"][:self.num_data], out["leaf_value"]
+
+    def _to_host_tree(self, out, shrink=1.0) -> Tree:
+        """ONE batched device->host transfer, then vectorized conversion."""
+        host = jax.device_get({k: v for k, v in out.items() if k != "row_leaf"})
+        return self.host_out_to_tree(host, shrink)
+
+    def host_out_to_tree(self, host, shrink=1.0) -> Tree:
+        """Convert one tree's host arrays (already fetched) into a Tree.
+        Also used by the fused multi-iteration path on per-iteration
+        slices of the scan-stacked outputs."""
+        n_splits = int(host["n_splits"])
         num_leaves = n_splits + 1
         t = Tree(num_leaves)
         if n_splits == 0:
             return t
         ds = self.train_set
-        sf = np.asarray(out["split_feature"])[:n_splits]
-        tb = np.asarray(out["split_threshold_bin"])[:n_splits]
+        sf = np.asarray(host["split_feature"])[:n_splits]
+        tb = np.asarray(host["split_threshold_bin"])[:n_splits]
         t.split_feature = sf.astype(np.int32)
         t.split_feature_real = ds.real_feature_idx[sf].astype(np.int32)
         t.threshold_in_bin = tb.astype(np.int32)
-        t.threshold = np.asarray(
-            [ds.bin_mappers[f].bin_to_value(b) for f, b in zip(sf, tb)], dtype=np.float64)
-        t.decision_type = np.asarray(
-            [1 if ds.bin_mappers[f].bin_type == 1 else 0 for f in sf], dtype=np.int8)
-        t.split_gain = np.asarray(out["split_gain"])[:n_splits].astype(np.float64)
-        t.left_child = np.asarray(out["left_child"])[:n_splits]
-        t.right_child = np.asarray(out["right_child"])[:n_splits]
-        t.leaf_parent = np.asarray(out["leaf_parent"])[:num_leaves]
-        t.leaf_value = np.asarray(out["leaf_value"])[:num_leaves].astype(np.float64)
-        t.leaf_count = np.asarray(out["leaf_count"])[:num_leaves]
-        t.internal_value = np.asarray(out["internal_value"])[:n_splits].astype(np.float64)
-        t.internal_count = np.asarray(out["internal_count"])[:n_splits]
+        t.threshold = self._bin_value_table[sf, tb]
+        t.decision_type = self._decision_type_host[sf]
+        t.split_gain = np.asarray(host["split_gain"])[:n_splits].astype(np.float64)
+        t.left_child = np.asarray(host["left_child"])[:n_splits]
+        t.right_child = np.asarray(host["right_child"])[:n_splits]
+        t.leaf_parent = np.asarray(host["leaf_parent"])[:num_leaves]
+        t.leaf_value = (np.asarray(host["leaf_value"])[:num_leaves]
+                        .astype(np.float64) * shrink)
+        t.leaf_count = np.asarray(host["leaf_count"])[:num_leaves]
+        t.internal_value = np.asarray(host["internal_value"])[:n_splits].astype(np.float64)
+        t.internal_count = np.asarray(host["internal_count"])[:n_splits]
         return t
 
 

@@ -131,7 +131,7 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
     name = "data"
     shard_rows = True
 
-    def _make_build_fn(self, cfg, chunk):
+    def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
         max_bin = self.max_bin
         params = self.params
@@ -145,12 +145,11 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
                 max_depth=max_depth, row_chunk=chunk,
                 hist_psum_fn=psum, sum_psum_fn=psum)
 
-        wrapped = jax.shard_map(
+        return jax.shard_map(
             dp_fn, mesh=self.mesh,
             in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
                       P(None), P(None), P(None)),
             out_specs=self._out_specs(), check_vma=False)
-        return jax.jit(wrapped)
 
 
 class FeatureParallelTreeLearner(_MeshedTreeLearner):
@@ -162,7 +161,7 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
     shard_rows = False
     shard_features = True
 
-    def _make_build_fn(self, cfg, chunk):
+    def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
         max_bin = self.max_bin
         params = self.params
@@ -208,7 +207,7 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
             return inner(bins, grad, hess, inbag, fmask, num_bin_pf,
                          is_cat, is_cat)
 
-        return jax.jit(wrapped7)
+        return wrapped7
 
 
 class VotingParallelTreeLearner(_MeshedTreeLearner):
@@ -217,7 +216,7 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
     name = "voting"
     shard_rows = True
 
-    def _make_build_fn(self, cfg, chunk):
+    def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
         max_bin = self.max_bin
         params = self.params
@@ -275,9 +274,8 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 max_depth=max_depth, row_chunk=chunk,
                 sum_psum_fn=psum, evaluate_fn=evaluate)
 
-        wrapped = jax.shard_map(
+        return jax.shard_map(
             voting_fn, mesh=self.mesh,
             in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
                       P(None), P(None), P(None)),
             out_specs=self._out_specs(), check_vma=False)
-        return jax.jit(wrapped)

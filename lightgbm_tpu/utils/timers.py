@@ -1,0 +1,57 @@
+"""Per-phase wall-clock timers.
+
+Reference observability surface: the cumulative network-time counters in
+include/LightGBM/network.h / src/network/linkers.h:195-212 and the
+per-iteration / load timers sprinkled through application.cpp. On TPU
+the phases that matter are different — gradient computation, tree build
+(device program + the scalar stop-check sync), score updates, host<->
+device sync, and metric evaluation — so the registry tracks those. XLA
+owns collective scheduling inside the compiled program; fine-grained
+collective time comes from `jax.profiler` traces (CLI flag `profile=1`),
+not host timers.
+
+Usage:
+    with TIMERS.phase("build"):
+        ...
+    Log.debug-level report via TIMERS.report() at end of training.
+"""
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class PhaseTimers:
+    def __init__(self):
+        self.acc = defaultdict(float)
+        self.cnt = defaultdict(int)
+
+    @contextmanager
+    def phase(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.acc[name] += time.perf_counter() - t0
+            self.cnt[name] += 1
+
+    def add(self, name, seconds):
+        self.acc[name] += seconds
+        self.cnt[name] += 1
+
+    def reset(self):
+        self.acc.clear()
+        self.cnt.clear()
+
+    def report(self):
+        """One line per phase, largest first."""
+        lines = []
+        for name in sorted(self.acc, key=lambda k: -self.acc[k]):
+            n = max(self.cnt[name], 1)
+            lines.append("%-12s %8.3fs total, %7.2fms/call x%d"
+                         % (name, self.acc[name], 1e3 * self.acc[name] / n,
+                            self.cnt[name]))
+        return "\n".join(lines)
+
+
+TIMERS = PhaseTimers()
