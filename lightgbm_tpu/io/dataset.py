@@ -182,6 +182,16 @@ class DatasetLoader:
                 self._attach_init_score(ds)
                 return ds
 
+        # two-round streaming path: peak memory O(block), the full float
+        # matrix never materializes (dataset_loader.cpp:505-610). Continued
+        # training needs raw values for init scores, so it keeps the
+        # in-memory path.
+        if cfg.use_two_round_loading and self.predict_fun is None:
+            ds = self._load_two_round(filename)
+            if cfg.is_save_binary_file:
+                ds.save_binary(bin_path)
+            return ds
+
         label, feats, names, fmt, label_idx = parse_text_file(
             filename, has_header=cfg.has_header, label_column=cfg.label_column)
         weight_idx, group_idx, ignore, categorical = self._resolve_columns(
@@ -226,6 +236,92 @@ class DatasetLoader:
         if self.predict_fun is not None:
             ds.raw_data = feats
         self._attach_init_score(ds)
+        return ds
+
+    # ------------------------------------------------- two-round streaming
+    def _load_two_round(self, filename) -> CoreDataset:
+        """Sample pass -> mappers -> binning pass (dataset_loader.cpp:505-610,
+        pipeline_reader.h/text_reader.h semantics; see io/streaming.py)."""
+        from .parser import detect_format
+        from .streaming import scan_file, iter_blocks, collect_sample_rows
+        cfg = self.config
+        fmt = detect_format(filename)
+        n, names, num_cols = scan_file(filename, fmt, cfg.has_header)
+        if n == 0:
+            Log.fatal("Data file %s is empty", str(filename))
+
+        # label column resolution (parser semantics)
+        label_idx = 0
+        if fmt != "libsvm" and cfg.label_column != "":
+            s = str(cfg.label_column)
+            if s.startswith("name:"):
+                if names is None or s[5:] not in names:
+                    Log.fatal("Could not find label column %s in data file", s[5:])
+                label_idx = names.index(s[5:])
+            else:
+                label_idx = int(s)
+        feat_names = ([nm for i, nm in enumerate(names) if i != label_idx]
+                      if names is not None else None)
+        num_feats = num_cols - 1
+        feat_cols = np.asarray([j for j in range(num_cols) if j != label_idx])
+
+        weight_idx, group_idx, ignore, categorical = self._resolve_columns(
+            feat_names, num_feats)
+        if weight_idx >= 0:
+            ignore.add(weight_idx)
+        if group_idx >= 0:
+            ignore.add(group_idx)
+
+        # round one: sample rows, find mappers (identical draws and
+        # therefore identical mappers to the in-memory path)
+        cnt = min(cfg.bin_construct_sample_cnt, n)
+        sample_idx = (np.arange(n, dtype=np.int64) if cnt == n
+                      else Random(cfg.data_random_seed).sample(n, cnt).astype(np.int64))
+        sample_all = collect_sample_rows(filename, fmt, cfg.has_header,
+                                         num_cols, sample_idx)
+        sample_feats = sample_all[:, feat_cols]
+        mappers, used_map, real_idx = self._make_mappers(
+            sample_feats, num_feats, ignore, categorical)
+
+        # round two: stream blocks, pushing binned values + metadata columns
+        dtype = np.uint8 if max(m.num_bin for m in mappers) <= 256 else np.uint16
+        bins = np.empty((len(mappers), n), dtype=dtype)
+        label = np.empty(n, dtype=np.float32)
+        weights = np.empty(n, dtype=np.float32) if weight_idx >= 0 else None
+        qid = np.empty(n, dtype=np.float64) if group_idx >= 0 else None
+        for start, block in iter_blocks(filename, fmt, cfg.has_header,
+                                        num_cols):
+            end = start + len(block)
+            label[start:end] = block[:, label_idx]
+            feats_block = block[:, feat_cols]
+            if weights is not None:
+                weights[start:end] = feats_block[:, weight_idx]
+            if qid is not None:
+                qid[start:end] = feats_block[:, group_idx]
+            for u, j in enumerate(real_idx):
+                bins[u, start:end] = mappers[u].value_to_bin(
+                    feats_block[:, j]).astype(dtype)
+
+        ds = CoreDataset()
+        ds.num_total_features = num_feats
+        ds.feature_names = (list(feat_names) if feat_names is not None
+                            else [f"Column_{i}" for i in range(num_feats)])
+        ds.bins = bins
+        ds.bin_mappers = mappers
+        ds.used_feature_map = used_map
+        ds.real_feature_idx = np.asarray(real_idx, dtype=np.int32)
+        ds.label_idx = label_idx
+
+        meta = Metadata(n)
+        meta.set_label(label)
+        if weights is not None:
+            meta.set_weights(weights)
+        if qid is not None:
+            meta.set_query(_qid_to_counts(qid))
+        meta.load_side_files(filename)
+        ds.metadata = meta
+        Log.info("Number of data: %d, number of features: %d (two-round)",
+                 n, len(mappers))
         return ds
 
     # --------------------------------------------------------- from matrix
@@ -282,21 +378,12 @@ class DatasetLoader:
         rnd = Random(cfg.data_random_seed)
         return rnd.sample(n, cnt).astype(np.int64)
 
-    def _construct(self, feats, names, ignore, categorical, meta) -> CoreDataset:
-        """Bin-mapper construction + feature extraction
-        (ConstructBinMappersFromTextData + ExtractFeatures, dataset_loader.cpp:612-841)."""
+    def _make_mappers(self, sample, num_total, ignore, categorical):
+        """Bin-mapper construction from sampled rows
+        (ConstructBinMappersFromTextData, dataset_loader.cpp:612-760)."""
         cfg = self.config
-        n, num_total = feats.shape
-        sample_idx = self._sample_rows(n)
-        sample = feats[sample_idx]
-
-        ds = CoreDataset()
-        ds.num_total_features = num_total
-        ds.feature_names = (list(names) if names is not None
-                            else [f"Column_{i}" for i in range(num_total)])
-
         used_map = np.full(num_total, -1, dtype=np.int32)
-        mappers, real_idx, bin_cols = [], [], []
+        mappers, real_idx = [], []
         for j in range(num_total):
             if j in ignore:
                 continue
@@ -310,14 +397,29 @@ class DatasetLoader:
             used_map[j] = len(mappers)
             real_idx.append(j)
             mappers.append(m)
-            bin_cols.append(m.value_to_bin(feats[:, j]))
-
         if not mappers:
             Log.fatal("Cannot construct Dataset since there are no useful features. "
                       "It should be at least two unique rows.")
+        return mappers, used_map, real_idx
 
+    def _construct(self, feats, names, ignore, categorical, meta) -> CoreDataset:
+        """Bin-mapper construction + feature extraction
+        (ConstructBinMappersFromTextData + ExtractFeatures, dataset_loader.cpp:612-841)."""
+        n, num_total = feats.shape
+        sample_idx = self._sample_rows(n)
+        sample = feats[sample_idx]
+
+        ds = CoreDataset()
+        ds.num_total_features = num_total
+        ds.feature_names = (list(names) if names is not None
+                            else [f"Column_{i}" for i in range(num_total)])
+
+        mappers, used_map, real_idx = self._make_mappers(
+            sample, num_total, ignore, categorical)
         dtype = np.uint8 if max(m.num_bin for m in mappers) <= 256 else np.uint16
-        ds.bins = np.stack([c.astype(dtype) for c in bin_cols], axis=0)
+        ds.bins = np.stack(
+            [mappers[used_map[j]].value_to_bin(feats[:, j]).astype(dtype)
+             for j in real_idx], axis=0)
         ds.bin_mappers = mappers
         ds.used_feature_map = used_map
         ds.real_feature_idx = np.asarray(real_idx, dtype=np.int32)

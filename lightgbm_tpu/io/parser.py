@@ -16,6 +16,20 @@ import numpy as np
 from ..utils.log import Log
 
 ZERO_THRESHOLD = 1e-10
+NA_VALUES = ["na", "NA", "nan", "NaN", "null"]
+
+
+def libsvm_pairs(tokens):
+    """Parse `idx:val` tokens, skipping malformed ones (empty index or
+    missing colon) — shared by the in-memory and streaming loaders so
+    both paths treat the same line identically."""
+    out = []
+    for tok in tokens:
+        c = tok.find(":")
+        if c <= 0:
+            continue
+        out.append((int(tok[:c]), float(tok[c + 1:])))
+    return out
 
 
 def _first_lines(path, n=2):
@@ -66,19 +80,13 @@ def _parse_libsvm(path, has_header):
                 continue
             parts = line.split()
             labels.append(float(parts[0]))
-            pairs = []
-            for tok in parts[1:]:
-                if ":" not in tok:
-                    continue
-                i, v = tok.split(":", 1)
-                i = int(i)
-                v = float(v)
+            pairs = libsvm_pairs(parts[1:])
+            for i, _ in pairs:
                 if i > max_idx:
                     max_idx = i
-                pairs.append((i, v))
             rows.append(pairs)
     n = len(rows)
-    mat = np.zeros((n, max_idx + 1), dtype=np.float32)
+    mat = np.zeros((n, max_idx + 1), dtype=np.float64)
     for r, pairs in enumerate(rows):
         for i, v in pairs:
             mat[r, i] = v
@@ -103,7 +111,7 @@ def parse_text_file(path, has_header=False, label_column=""):
 
     sep = "," if fmt == "csv" else "\t"
     df = pd.read_csv(path, sep=sep, header=0 if has_header else None,
-                     dtype=np.float64, na_values=["na", "NA", "nan", "NaN", "null"])
+                     dtype=np.float64, na_values=NA_VALUES)
     names = [str(c) for c in df.columns] if has_header else None
     data = df.to_numpy(dtype=np.float64)
     data = np.nan_to_num(data, nan=0.0)
@@ -119,7 +127,9 @@ def parse_text_file(path, has_header=False, label_column=""):
             label_idx = int(label_column)
 
     label = data[:, label_idx].astype(np.float32)
-    feats = np.delete(data, label_idx, axis=1).astype(np.float32)
+    # keep float64: the reference parses and bins in double (parser.hpp),
+    # and a float32 round-trip perturbs bin boundaries in the last digit
+    feats = np.delete(data, label_idx, axis=1)
     feat_names = None
     if names is not None:
         feat_names = [n for i, n in enumerate(names) if i != label_idx]

@@ -1,0 +1,114 @@
+"""Streaming (two-round) text loading: O(block) host memory.
+
+Reference: include/LightGBM/utils/pipeline_reader.h:18-70 (block reads),
+include/LightGBM/utils/text_reader.h:21-311 (count / sample / filtered
+reads), and the two-round path of src/io/dataset_loader.cpp:505-610:
+round one samples rows to find bin boundaries, round two re-reads the
+file pushing binned values directly into feature storage, so the full
+float matrix never exists in memory.
+
+Host-side design: pandas' C tokenizer already does double-buffered block
+reads internally (`chunksize=`), so the pipeline reader collapses to a
+block iterator; the value-add here is the two-round protocol itself
+(sample pass -> mapper construction -> binning pass) with peak memory
+O(block_rows x cols) + the uint8 bin matrix, instead of the O(N x cols)
+float64 matrix of the in-memory path.
+"""
+
+import numpy as np
+
+from ..utils.log import Log
+from .parser import detect_format, libsvm_pairs, NA_VALUES, ZERO_THRESHOLD
+
+DEFAULT_BLOCK_ROWS = 1 << 16
+
+
+def scan_file(path, fmt, has_header):
+    """First pass: row count + (names, num_cols). For LibSVM also
+    discovers the column count (max index + 1) — text_reader.h CountLine
+    plus the reference's max-idx scan."""
+    if fmt == "libsvm":
+        n = 0
+        max_idx = -1
+        with open(path, "r") as f:
+            if has_header:
+                next(f, None)
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                n += 1
+                for idx, _ in libsvm_pairs(line.split()[1:]):
+                    if idx > max_idx:
+                        max_idx = idx
+        # +1 for the label column so num_cols matches the dense formats
+        return n, None, max_idx + 2
+    names = None
+    with open(path, "r") as f:
+        first = f.readline().rstrip("\r\n")
+        sep = "," if fmt == "csv" else "\t"
+        cols = first.split(sep)
+        num_cols = len(cols)
+        if has_header:
+            names = [str(c) for c in cols]
+            n = 0
+        else:
+            n = 1 if first.strip() else 0
+        for line in f:
+            if line.strip():
+                n += 1
+    return n, names, num_cols
+
+
+def iter_blocks(path, fmt, has_header, num_cols, block_rows=DEFAULT_BLOCK_ROWS):
+    """Second/third pass: yield (row_start, float64 (b, num_cols) block)
+    with NaNs zeroed, matching parse_text_file's dense semantics."""
+    if fmt == "libsvm":
+        buf = np.zeros((block_rows, num_cols), dtype=np.float64)
+        fill = 0
+        start = 0
+        with open(path, "r") as f:
+            if has_header:
+                next(f, None)
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split()
+                buf[fill, 0] = float(parts[0])
+                for idx, val in libsvm_pairs(parts[1:]):
+                    buf[fill, idx + 1] = val
+                fill += 1
+                if fill == block_rows:
+                    yield start, buf[:fill]
+                    start += fill
+                    fill = 0
+                    buf = np.zeros((block_rows, num_cols), dtype=np.float64)
+        if fill:
+            yield start, buf[:fill]
+        return
+
+    import pandas as pd
+    sep = "," if fmt == "csv" else "\t"
+    start = 0
+    for chunk in pd.read_csv(path, sep=sep, header=0 if has_header else None,
+                             dtype=np.float64, na_values=NA_VALUES,
+                             chunksize=block_rows):
+        block = np.nan_to_num(chunk.to_numpy(dtype=np.float64), nan=0.0)
+        yield start, block
+        start += len(block)
+
+
+def collect_sample_rows(path, fmt, has_header, num_cols, sample_idx,
+                        block_rows=DEFAULT_BLOCK_ROWS):
+    """Round one: gather the (ascending) sampled row indices in one
+    streaming pass (text_reader.h SampleFromFile)."""
+    sample_idx = np.asarray(sample_idx, dtype=np.int64)
+    out = np.empty((len(sample_idx), num_cols), dtype=np.float64)
+    for start, block in iter_blocks(path, fmt, has_header, num_cols,
+                                    block_rows):
+        lo = np.searchsorted(sample_idx, start)
+        hi = np.searchsorted(sample_idx, start + len(block))
+        if hi > lo:
+            out[lo:hi] = block[sample_idx[lo:hi] - start]
+    return out

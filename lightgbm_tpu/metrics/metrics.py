@@ -174,28 +174,16 @@ class NDCGMetric(Metric):
         self.query_boundaries = np.asarray(metadata.query_boundaries)
         self.num_queries = len(self.query_boundaries) - 1
         self.query_weights = metadata.query_weights
-        self.sum_query_weights = (float(self.num_queries) if self.query_weights is None
-                                  else float(np.sum(self.query_weights)))
-        self.inverse_max_dcgs = []
-        for q in range(self.num_queries):
-            lo, hi = self.query_boundaries[q], self.query_boundaries[q + 1]
-            self.inverse_max_dcgs.append(
-                [self.dcg.cal_maxdcg_at_k(k, self.label[lo:hi]) for k in self.eval_at])
+        from ..objectives.rank_device import PaddedQueryLayout
+        self.layout = PaddedQueryLayout(self.query_boundaries, num_data)
 
     def eval(self, score):
+        """Vectorized padded-query NDCG (one argsort for all queries)
+        instead of the reference's per-query loop (rank_metric.hpp)."""
+        from ..objectives.rank_device import ndcg_eval_padded
         s = np.asarray(score, dtype=np.float64)[:self.num_data]
-        result = np.zeros(len(self.eval_at))
-        for q in range(self.num_queries):
-            lo, hi = self.query_boundaries[q], self.query_boundaries[q + 1]
-            qw = 1.0 if self.query_weights is None else float(self.query_weights[q])
-            for j, k in enumerate(self.eval_at):
-                maxdcg = self.inverse_max_dcgs[q][j]
-                if maxdcg > 0:
-                    dcg = self.dcg.cal_dcg_at_k(k, self.label[lo:hi], s[lo:hi])
-                    result[j] += qw * dcg / maxdcg
-                else:
-                    result[j] += qw  # reference counts un-rankable queries as 1
-        return [float(r / self.sum_query_weights) for r in result]
+        return ndcg_eval_padded(self.layout, self.label, self.dcg.label_gain,
+                                self.eval_at, s, self.query_weights)
 
 
 def create_metric(name, config):

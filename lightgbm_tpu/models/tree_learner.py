@@ -9,23 +9,19 @@ TPU-first design (diverges deliberately from the C++ class graph):
 
 - The entire tree grows inside one jitted `lax.fori_loop`: static
   shapes, no host round-trips per split.
-- The row partition is kept BOTH as a dense (N,) `row_leaf` map (for the
-  score updater) and as an `ord_idx` index array grouped by leaf into
-  contiguous segments with `leaf_start`/`leaf_rows` — the analog of the
-  reference's DataPartition, maintained by a stable cumsum compaction
-  (data_partition.hpp:90-140 does the same with per-thread buffers).
-- Histograms: only the SMALLER child is computed per split; the larger
-  child is parent − smaller from a per-leaf (L, F, B, 3) histogram
-  cache (the subtraction trick; the reference's LRU HistogramPool
-  becomes a fixed HBM buffer — 63 leaves × 28 feat × 256 bins × 3
-  stats ≈ 5 MB for the HIGGS shape).
-- The smaller child's rows are gathered from its `ord_idx` segment into
-  one of a few SIZE-BUCKETED static buffers (N/2, N/4, ... rounded to
-  the scan chunk) chosen with `lax.switch`, then reduced with the
-  one-hot MXU contraction (ops/histogram.py). This keeps every shape
-  static while making per-split cost proportional to the (bucketed)
-  leaf size instead of O(N) — the reason the reference partitions rows
-  at all.
+- The row partition is ONLY the dense (N,) `row_leaf` map. The
+  reference's DataPartition (ordered row indices per leaf,
+  data_partition.hpp:90-140) exists to make per-leaf histogram cost
+  proportional to leaf size via gathers; on TPU random gathers are
+  latency-bound, so per-split histograms instead stream the full bin
+  matrix with the leaf selected by a row_leaf mask — sequential HBM
+  reads at full bandwidth (ops/pallas_hist.py). Updating the partition
+  after a split is a single vectorized `where` on row_leaf.
+- Histograms: only the SMALLER child (by global in-bag count) is
+  computed per split; the larger child is parent − smaller from a
+  per-leaf (L, F, B, 3) histogram cache (the subtraction trick; the
+  reference's LRU HistogramPool becomes a fixed HBM buffer — 63 leaves
+  × 28 feat × 256 bins × 3 stats ≈ 5 MB for the HIGGS shape).
 - Collectives are injected through hooks so the parallel learners
   (parallel/learners.py) reuse this exact builder under `shard_map`:
   `hist_psum_fn` reduces histograms across row shards (the reference's
@@ -44,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.histogram import build_histograms
+from ..ops.pallas_hist import masked_histograms, HIST_CHUNK
 from ..ops.split import SplitParams, find_best_split, K_MIN_SCORE
 from ..utils.random import Random
 from ..utils.log import Log
@@ -53,24 +49,6 @@ from .tree import Tree
 
 def _identity(x):
     return x
-
-
-def bucket_sizes(n_pad, chunk):
-    """Static gather-buffer sizes: n_pad, ~n_pad/2, ~n_pad/4, ... floor
-    `chunk`, each rounded up to a multiple of `chunk` so the chunked
-    histogram scan stays aligned."""
-    if n_pad <= chunk:
-        return [n_pad]
-    sizes = [n_pad]
-    s = n_pad
-    while True:
-        s = max(chunk, ((s // 2 + chunk - 1) // chunk) * chunk)
-        if s >= sizes[-1]:
-            break
-        sizes.append(s)
-        if s == chunk:
-            break
-    return sizes
 
 
 def build_tree_device(bins, grad, hess, inbag, feature_mask,
@@ -119,48 +97,29 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
 
     g_in = grad * inbag
     h_in = hess * inbag
+    # packed per-row stats, stats-major for the masked histogram kernel
+    ghc_t = jnp.stack([g_in, h_in, inbag], axis=0)  # (3, N_pad)
 
-    # ---- bucketed smaller-child histogram ------------------------------
-    sizes = bucket_sizes(n_pad, row_chunk)
-    sizes_arr = jnp.asarray(sizes, dtype=jnp.int32)
-
-    def seg_hist(size, ord_idx, start, count):
-        """Histogram of rows ord_idx[start : start+count] via a static
-        `size`-row gather (count <= size; excess positions masked)."""
-        start_c = jnp.clip(jnp.minimum(start, n_pad - size), 0)
-        idx = jax.lax.dynamic_slice(ord_idx, (start_c,), (size,))
-        pos = start_c + jnp.arange(size, dtype=jnp.int32)
-        m = ((pos >= start) & (pos < start + count)).astype(f32)
-        ghc = jnp.stack([jnp.take(g_in, idx) * m,
-                         jnp.take(h_in, idx) * m,
-                         jnp.take(inbag, idx) * m], axis=1)
-        sub_bins = jnp.take(bins, idx, axis=1)
-        return build_histograms(sub_bins, ghc, b, min(row_chunk, size))
-
-    hist_branches = [functools.partial(seg_hist, s) for s in sizes]
-
-    def segment_histogram(ord_idx, start, count):
-        bidx = jnp.sum(sizes_arr >= count) - 1
-        return jax.lax.switch(bidx, hist_branches, ord_idx, start, count)
+    def leaf_histogram(row_leaf, leaf_id):
+        """Full-bandwidth streaming pass selecting `leaf_id`'s rows by
+        mask (ops/pallas_hist.py) — the TPU replacement for the
+        reference's ordered-gather ConstructHistogram."""
+        return masked_histograms(bins, ghc_t, row_leaf, leaf_id, b,
+                                 row_chunk)
 
     # ---- root ----------------------------------------------------------
     root_g = sum_psum_fn(jnp.sum(g_in))
     root_h = sum_psum_fn(jnp.sum(h_in))
     root_c = sum_psum_fn(jnp.sum(inbag))
-    hist_root = hist_psum_fn(
-        build_histograms(bins, jnp.stack([g_in, h_in, inbag], axis=1),
-                         b, row_chunk))
+    row_leaf0 = jnp.zeros(n_pad, dtype=jnp.int32)
+    hist_root = hist_psum_fn(leaf_histogram(row_leaf0, jnp.int32(0)))
     root_split = scan_leaf(hist_root, root_g, root_h, root_c)
 
     def set0(arr, v):
         return arr.at[0].set(v)
 
     state = {
-        "row_leaf": jnp.zeros(n_pad, dtype=jnp.int32),
-        # DataPartition: row indices grouped by leaf + segment table
-        "ord_idx": jnp.arange(n_pad, dtype=jnp.int32),
-        "leaf_start": jnp.zeros(l, dtype=jnp.int32),
-        "leaf_rows": jnp.zeros(l, dtype=jnp.int32).at[0].set(n_pad),
+        "row_leaf": row_leaf0,
         # per-leaf histogram cache (HistogramPool, fixed buffer)
         "hist_cache": jnp.zeros((l, f, b, 3), dtype=f32).at[0].set(hist_root),
         "done": jnp.asarray(False),
@@ -236,42 +195,20 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
                                 .at[right_id].set(st["best_rc"][best_leaf].astype(jnp.int32)))
             st["n_splits"] = st["n_splits"] + 1
 
-            # ---- partition update (DataPartition::Split)
+            # ---- partition update (DataPartition::Split): one where()
             col = split_col_fn(feat)
-            # dense row->leaf map (score updater output)
             go_left_row = jnp.where(is_cat[feat], col == thr, col <= thr)
             in_leaf = st["row_leaf"] == best_leaf
             st["row_leaf"] = jnp.where(in_leaf & ~go_left_row, right_id,
                                        st["row_leaf"])
-            # ordered-index stable compaction within the leaf's segment
-            seg_s = st["leaf_start"][best_leaf]
-            seg_n = st["leaf_rows"][best_leaf]
-            pos = jnp.arange(n_pad, dtype=jnp.int32)
-            inseg = (pos >= seg_s) & (pos < seg_s + seg_n)
-            vals = jnp.take(col, st["ord_idx"])
-            go_l = inseg & jnp.where(is_cat[feat], vals == thr, vals <= thr)
-            go_r = inseg & ~go_l
-            cl_rows = jnp.sum(go_l.astype(jnp.int32))
-            lcum = jnp.cumsum(go_l.astype(jnp.int32))
-            rcum = jnp.cumsum(go_r.astype(jnp.int32))
-            newpos = jnp.where(go_l, seg_s + lcum - 1,
-                               jnp.where(go_r, seg_s + cl_rows + rcum - 1, pos))
-            st["ord_idx"] = jnp.zeros_like(st["ord_idx"]).at[newpos].set(st["ord_idx"])
-            st["leaf_start"] = (st["leaf_start"].at[best_leaf].set(seg_s)
-                                .at[right_id].set(seg_s + cl_rows))
-            st["leaf_rows"] = (st["leaf_rows"].at[best_leaf].set(cl_rows)
-                               .at[right_id].set(seg_n - cl_rows))
 
             # ---- smaller-child histogram + parent subtraction
             # smaller side by GLOBAL in-bag count (consistent across row
-            # shards; data_parallel_tree_learner.cpp:178-187), bucket by
-            # LOCAL row count (shard-divergent is fine: no collectives
-            # inside the switch)
+            # shards; data_parallel_tree_learner.cpp:178-187)
             left_is_small = st["best_lc"][best_leaf] <= st["best_rc"][best_leaf]
-            small_start = jnp.where(left_is_small, seg_s, seg_s + cl_rows)
-            small_rows = jnp.where(left_is_small, cl_rows, seg_n - cl_rows)
+            small_leaf = jnp.where(left_is_small, best_leaf, right_id)
             hist_small = hist_psum_fn(
-                segment_histogram(st["ord_idx"], small_start, small_rows))
+                leaf_histogram(st["row_leaf"], small_leaf.astype(jnp.int32)))
             hist_large = st["hist_cache"][best_leaf] - hist_small
             hist_left = jnp.where(left_is_small, hist_small, hist_large)
             hist_right = jnp.where(left_is_small, hist_large, hist_small)
@@ -394,6 +331,9 @@ class SerialTreeLearner:
 
     # hooks overridden by the parallel learners (parallel/learners.py) -------
     def _pad_rows(self, n, chunk):
+        if jax.default_backend() == "tpu":
+            # the pallas histogram kernel grids over fixed HIST_CHUNK blocks
+            return ((n + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
         return ((n + chunk - 1) // chunk) * chunk if n > chunk else n
 
     def _effective_chunk(self, chunk):

@@ -143,10 +143,11 @@ class MulticlassLogloss(ObjectiveFunction):
 class LambdarankNDCG(ObjectiveFunction):
     """LambdaRank with NDCG weighting (rank_objective.hpp:19-227).
 
-    Host numpy implementation, vectorized per query over the full pair
-    matrix. The reference's 1M-entry sigmoid lookup table is replaced by
-    the exact expression 2/(1+exp(2*sigma*x)) with the same clamping
-    range — the table is a CPU latency trick, not a semantic feature.
+    Gradients run ON DEVICE via the padded-query pairwise kernel
+    (rank_device.py) — `self._grad` is the jitted function, which also
+    makes lambdarank eligible for the fused multi-iteration trainer.
+    The float64 host path below is kept as the accuracy reference
+    (tests pin the two against each other).
     """
 
     name = "lambdarank"
@@ -173,12 +174,20 @@ class LambdarankNDCG(ObjectiveFunction):
             lo, hi = self.query_boundaries[q], self.query_boundaries[q + 1]
             maxdcg = self.dcg.cal_maxdcg_at_k(self.optimize_pos_at, self.label[lo:hi])
             self.inverse_max_dcgs[q] = 1.0 / maxdcg if maxdcg > 0 else 0.0
+        from .rank_device import PaddedQueryLayout, make_lambdarank_gradfn
+        self.layout = PaddedQueryLayout(self.query_boundaries, num_data)
+        self._grad = make_lambdarank_gradfn(
+            self.layout, self.label, self.label_gain, self.sigmoid,
+            self.optimize_pos_at, self.weights)
+
+    def get_gradients(self, score):
+        return self._grad(jnp.asarray(score, dtype=jnp.float32).reshape(1, -1))
 
     def _sigmoid(self, x):
         x = np.clip(x, self.min_input, self.max_input)
         return 2.0 / (1.0 + np.exp(2.0 * x * self.sigmoid))
 
-    def get_gradients(self, score):
+    def get_gradients_host(self, score):
         score = np.asarray(score, dtype=np.float32).reshape(-1)
         grad = np.zeros_like(score, dtype=np.float64)
         hess = np.zeros_like(score, dtype=np.float64)

@@ -1,0 +1,115 @@
+"""Pallas TPU kernel for masked gradient histograms — the hot op.
+
+Reference semantics: the per-feature accumulation loops in
+src/io/dense_bin.hpp:16-195 / ordered_sparse_bin.hpp ConstructHistogram:
+for every row in one leaf, hist[feature, bin] += (grad, hess, count).
+
+TPU-first design. The reference (and our first build) materializes the
+leaf's rows via a maintained row partition and gathers them; on TPU
+random gathers are latency-bound and the XLA one-hot einsum materializes
+a (F, C, B) one-hot in HBM. This kernel instead streams the FULL bin
+matrix once per histogram and selects the leaf with a mask on the
+row->leaf map:
+
+    hist[f, b, k] = sum_c [bins[f, c] == b] * [row_leaf[c] == leaf] * ghc[k, c]
+
+Per grid step (a row chunk C): bins (F, C) uint8, ghc (3, C) f32 and
+row_leaf (1, C) int32 are DMA'd to VMEM (~(F+13)*C bytes — the one-hot
+never touches HBM), the mask multiplies ghc, and each feature does one
+(3, C) @ (C, B) MXU contraction accumulated into a VMEM-resident
+(F, 3, B) output. HBM traffic per histogram is bins + ghc + row_leaf
+(~44 MB at 1M rows), two orders of magnitude below the einsum path.
+
+f32 operands give true f32 accumulation (better than XLA's default
+bfloat16 matmul passes); the count column comes out exactly integral.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# rows per grid step: the transient one-hot is (CHUNK, B_pad) f32 in
+# VMEM (2 MB at 2048 x 256); row padding must be a multiple of this.
+HIST_CHUNK = 2048
+
+
+def _hist_kernel(leaf_ref, bins_ref, ghc_ref, rl_ref, out_ref, *, f, b_pad):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    mask = (rl_ref[0, :] == leaf_ref[0]).astype(jnp.float32)      # (C,)
+    ghc_m = ghc_ref[...] * mask[None, :]                          # (3, C)
+    c = bins_ref.shape[1]
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (c, b_pad), 1)
+    for i in range(f):
+        onehot = (bins_ref[i, :].astype(jnp.int32)[:, None]
+                  == col_ids).astype(jnp.float32)                 # (C, B_pad)
+        out_ref[i, :, :] += jax.lax.dot_general(
+            ghc_m, onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total):
+    """hist[f, b, k] over rows with row_leaf == leaf_id (TPU kernel).
+
+    Args:
+      bins: (F, N) uint8/uint16/int32 bin matrix, N % HIST_CHUNK == 0.
+      ghc_t: (3, N) float32 stats (grad*inbag, hess*inbag, inbag).
+      row_leaf: (N,) int32 row->leaf map.
+      leaf_id: int32 scalar (traced ok).
+      num_bins_total: static B.
+
+    Returns (F, B, 3) float32.
+    """
+    f, n = bins.shape
+    if n % HIST_CHUNK != 0:
+        raise ValueError(f"N={n} must be a multiple of {HIST_CHUNK}")
+    b_pad = max(((num_bins_total + 127) // 128) * 128, 128)
+    grid = (n // HIST_CHUNK,)
+
+    kernel = functools.partial(_hist_kernel, f=f, b_pad=b_pad)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # leaf id (1,)
+            pl.BlockSpec((f, HIST_CHUNK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, HIST_CHUNK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, HIST_CHUNK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((f, 3, b_pad), lambda i: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f, 3, b_pad), jnp.float32),
+    )(jnp.asarray([leaf_id], dtype=jnp.int32), bins, ghc_t,
+      row_leaf.reshape(1, n))
+    return out.transpose(0, 2, 1)[:, :num_bins_total, :]
+
+
+def masked_histograms_xla(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
+                          row_chunk=HIST_CHUNK):
+    """Reference XLA implementation (CPU tests / non-TPU backends): the
+    chunked one-hot einsum of ops/histogram.py with the leaf mask folded
+    into the stats."""
+    from .histogram import build_histograms
+    mask = (row_leaf == leaf_id).astype(jnp.float32)
+    ghc = (ghc_t * mask[None, :]).T
+    return build_histograms(bins, ghc, num_bins_total, row_chunk)
+
+
+def masked_histograms(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
+                      row_chunk=HIST_CHUNK):
+    """Backend dispatch, resolved at trace time."""
+    if jax.default_backend() == "tpu":
+        return masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id,
+                                     num_bins_total)
+    return masked_histograms_xla(bins, ghc_t, row_leaf, leaf_id,
+                                 num_bins_total, row_chunk)

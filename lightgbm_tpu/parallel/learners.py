@@ -86,7 +86,10 @@ class _MeshedTreeLearner(SerialTreeLearner):
             return super()._pad_rows(n, chunk)
         k = self.n_shards
         local = (n + k - 1) // k
-        if local > chunk:
+        if jax.default_backend() == "tpu":
+            from ..ops.pallas_hist import HIST_CHUNK
+            local = ((local + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
+        elif local > chunk:
             local = ((local + chunk - 1) // chunk) * chunk
         return local * k
 

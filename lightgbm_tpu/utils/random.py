@@ -26,20 +26,20 @@ class Random:
         return float(self._rng.random_sample())
 
     def sample(self, n: int, k: int) -> np.ndarray:
-        """K ordered samples from {0..N-1} via sequential selection sampling
-        (random.h:55-68)."""
+        """K ordered samples from {0..N-1} (random.h:55-68).
+
+        The reference's sequential selection sampling is an O(N) scalar
+        loop; sampling the k smallest of N uniform keys draws the same
+        uniform-over-k-subsets distribution (and consumes the same N
+        draws from the stream) fully vectorized — an 11M-row bin-sample
+        is three numpy ops instead of an 11M-iteration Python loop.
+        """
         if k > n or k < 0:
             return np.empty(0, dtype=np.int32)
-        # vectorized equivalent of the sequential scheme: draw u_i and keep
-        # i if u_i < (k - taken) / (n - i). Done in one pass on host.
         u = self._rng.random_sample(n)
-        out = []
-        taken = 0
-        for i in range(n):
-            if u[i] < (k - taken) / (n - i):
-                out.append(i)
-                taken += 1
-        return np.asarray(out, dtype=np.int32)
+        if k == n:
+            return np.arange(n, dtype=np.int32)
+        return np.sort(np.argpartition(u, k)[:k]).astype(np.int32)
 
     def sample_mask(self, n: int, k: int) -> np.ndarray:
         """Boolean mask variant of `sample`."""
