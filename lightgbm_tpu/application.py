@@ -111,6 +111,10 @@ class Application:
     def init_train(self):
         cfg = self.config
         if cfg.is_parallel:
+            # multi-host membership (the reference's Network::Init TCP
+            # handshake, application.cpp:189) -> jax.distributed
+            from .parallel.distributed import init_from_config
+            init_from_config(cfg)
             Log.info("Parallel training over a %d-device mesh "
                      "(tree_learner=%s)", cfg.num_machines, cfg.tree_learner)
         self.boosting = create_boosting(cfg.boosting_type, cfg.input_model)
@@ -135,8 +139,10 @@ class Application:
                 self.boosting.load_model_from_string(f.read())
             predictor = Predictor(self.boosting, is_raw_score=True)
             predict_fun = predictor.init_score_fun()
+        import jax
         loader = DatasetLoader(cfg, predict_fun=predict_fun)
-        self.train_data = loader.load_from_file(cfg.data)
+        self.train_data = loader.load_from_file(
+            cfg.data, rank=jax.process_index(), num_machines=cfg.num_machines)
         if cfg.is_training_metric:
             for name in cfg.metric:
                 m = create_metric(name, cfg)
@@ -191,7 +197,9 @@ class Application:
                 Log.info("Wrote jax.profiler trace to %s", trace_dir)
         if TIMERS.acc:
             Log.debug("Per-phase timers:\n%s", TIMERS.report())
-        self.boosting.save_model_to_file(-1, cfg.output_model)
+        import jax
+        if jax.process_index() == 0:  # every rank has the identical model
+            self.boosting.save_model_to_file(-1, cfg.output_model)
         Log.info("Finished training")
 
     # ------------------------------------------------------------ prediction

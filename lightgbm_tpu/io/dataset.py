@@ -57,6 +57,7 @@ class CoreDataset:
         self.metadata = Metadata()
         self._device_bins = None
         self.raw_data = None          # optional (N, C) float32 original values
+        self.global_num_data = None   # set by per-rank loading (multi-host)
 
     # ------------------------------------------------------------ properties
     @property
@@ -162,6 +163,34 @@ class DatasetLoader:
         self.predict_fun = predict_fun  # init-score hook for continued training
 
     # ----------------------------------------------------------- from file
+    def _apply_rank_partition(self, ds, rank, num_machines):
+        """Per-rank row distribution for multi-host training
+        (dataset_loader.cpp:505-550): contiguous query-aligned blocks;
+        bin mappers stay global (built before the cut) so CheckAlign
+        holds across ranks. Only active under jax.distributed."""
+        import jax
+        if (num_machines <= 1 or jax.process_count() <= 1
+                or self.config.is_pre_partition
+                # feature-parallel replicates rows on every machine
+                # (config.cpp:173-176, application.cpp:125-131)
+                or self.config.tree_learner == "feature"):
+            return ds
+        from ..parallel.distributed import partition_rows
+        n = ds.num_data
+        qb = ds.metadata.query_boundaries
+        lo, hi = partition_rows(n, rank, num_machines, qb)
+        out = ds.subset(np.arange(lo, hi))
+        out.global_num_data = n
+        # query-aligned blocks can be uneven; every rank pads to the
+        # LARGEST block so global array shapes agree (learners._pad_rows)
+        out.local_rows_max = max(
+            partition_rows(n, r, num_machines, qb)[1]
+            - partition_rows(n, r, num_machines, qb)[0]
+            for r in range(num_machines))
+        Log.info("Rank %d/%d holds rows [%d, %d) of %d",
+                 rank, num_machines, lo, hi, n)
+        return out
+
     def load_from_file(self, filename, rank=0, num_machines=1) -> CoreDataset:
         cfg = self.config
         bin_path = str(filename) + ".bin"
@@ -180,7 +209,7 @@ class DatasetLoader:
                     continue  # not a binary cache; fall through
                 Log.info("Loaded binary dataset %s", cand)
                 self._attach_init_score(ds)
-                return ds
+                return self._apply_rank_partition(ds, rank, num_machines)
 
         # two-round streaming path: peak memory O(block), the full float
         # matrix never materializes (dataset_loader.cpp:505-610). Continued
@@ -190,7 +219,7 @@ class DatasetLoader:
             ds = self._load_two_round(filename)
             if cfg.is_save_binary_file:
                 ds.save_binary(bin_path)
-            return ds
+            return self._apply_rank_partition(ds, rank, num_machines)
 
         label, feats, names, fmt, label_idx = parse_text_file(
             filename, has_header=cfg.has_header, label_column=cfg.label_column)
@@ -217,7 +246,7 @@ class DatasetLoader:
         self._attach_init_score(ds)
         if cfg.is_save_binary_file:
             ds.save_binary(bin_path)
-        return ds
+        return self._apply_rank_partition(ds, rank, num_machines)
 
     def load_from_file_align_with_other_dataset(self, filename, train_ds) -> CoreDataset:
         """Valid-set path: bin with the TRAIN mappers (dataset_loader.cpp:222-266)."""

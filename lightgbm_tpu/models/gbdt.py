@@ -213,6 +213,7 @@ class GBDT:
         with TIMERS.phase("bagging"):
             inbag = self._bagging(self.iter)
         n = self.num_data
+        multi_host = getattr(self.tree_learner, "n_proc", 1) > 1
         for k in range(self.num_class):
             with TIMERS.phase("build"):
                 out = self.tree_learner.train_device(
@@ -224,13 +225,19 @@ class GBDT:
             # scores via device bin-space traversal. A 0-split tree makes
             # every update a no-op (leaf values are all zero), so checking
             # afterwards is safe.
+            tree = LazyTree(out, self.tree_learner, shrink=self.shrinkage_rate)
             with TIMERS.phase("score_upd"):
                 self.train_score_updater.add_score_by_partition(
-                    out["leaf_value"] * self.shrinkage_rate,
-                    out["row_leaf"][:n], k)
+                    self.tree_learner.local_leaf_values(out) * self.shrinkage_rate,
+                    self.tree_learner.local_row_leaf(out, n), k)
                 for updater in self.valid_score_updaters:
-                    updater.add_score_by_device_tree(out, self.shrinkage_rate, k)
-            tree = LazyTree(out, self.tree_learner, shrink=self.shrinkage_rate)
+                    if multi_host:
+                        # device-tree traversal would mix global and local
+                        # arrays; materialize once and score on host
+                        updater.add_score_by_tree(tree, k)
+                    else:
+                        updater.add_score_by_device_tree(
+                            out, self.shrinkage_rate, k)
             with TIMERS.phase("host_sync"):
                 stopped = tree.num_leaves <= 1  # scalar sync: the only wait
             if stopped:
@@ -264,7 +271,6 @@ class GBDT:
         if cfg is None or self.objective is None:
             return False
         return (type(self).__name__ == "GBDT"
-                and self.num_class == 1
                 and not self.valid_score_updaters
                 and (cfg.metric_freq <= 0 or not self.training_metrics)
                 and self.early_stopping_round <= 0
@@ -298,12 +304,26 @@ class GBDT:
         inbag = jnp.concatenate([jnp.ones(n, jnp.float32),
                                  jnp.zeros(pad, jnp.float32)])
 
+        num_class = self.num_class
+
         def step(score, fmask):
             g, h = grad_fn(score)
-            out = core(bins, jnp.pad(g[0], (0, pad)), jnp.pad(h[0], (0, pad)),
-                       inbag, fmask, nbpf, iscat)
-            upd = jnp.take(out["leaf_value"], out["row_leaf"][:n]) * shrink
-            score = score.at[0].add(upd)
+            gp = jnp.pad(g, ((0, 0), (0, pad)))
+            hp = jnp.pad(h, ((0, 0), (0, pad)))
+            if num_class == 1:
+                out = core(bins, gp[0], hp[0], inbag, fmask, nbpf, iscat)
+                upd = jnp.take(out["leaf_value"], out["row_leaf"][:n])[None, :]
+            else:
+                # one device program for ALL classes: vmap the whole-tree
+                # builder over the class axis (SURVEY M2; the reference
+                # loops classes serially, gbdt.cpp:210-245)
+                out = jax.vmap(
+                    lambda gg, hh: core(bins, gg, hh, inbag, fmask,
+                                        nbpf, iscat))(gp, hp)
+                upd = jax.vmap(
+                    lambda lv, rl: jnp.take(lv, rl[:n]))(
+                        out["leaf_value"], out["row_leaf"])
+            score = score + upd * shrink
             del out["row_leaf"]  # keep the stacked ys O(iter * num_leaves)
             return score, out
 
@@ -342,18 +362,44 @@ class GBDT:
         final_score, stacked = fn(self.train_score_updater.score, fmasks)
         self.train_score_updater.score = final_score
         host = jax.device_get(stacked)  # ONE transfer for the whole block
-        nsp = np.asarray(host["n_splits"])
-        t_eff = int(np.argmax(nsp == 0)) if bool((nsp == 0).any()) else num_iters
+        nsp = np.asarray(host["n_splits"]).reshape(num_iters, -1)  # (T, K)
+        empty = (nsp == 0).any(axis=1)
+        t_eff = int(np.argmax(empty)) if bool(empty.any()) else num_iters
+        # classes BEFORE the first empty one in the stopping iteration are
+        # kept, matching the sequential path (gbdt.cpp:222-236 push_back
+        # each class tree until the empty one)
+        k_stop = (int(np.argmax(nsp[t_eff] == 0))
+                  if t_eff < num_iters else 0)
+
+        def slice_at(t, k):
+            if self.num_class == 1:
+                return {key: v[t] for key, v in host.items()}
+            return {key: v[t, k] for key, v in host.items()}
+
         for t in range(t_eff):
-            tree = learner.host_out_to_tree(
-                {k: v[t] for k, v in host.items()}, shrink=self.shrinkage_rate)
-            self.models.append(tree)
+            for k in range(self.num_class):
+                self.models.append(learner.host_out_to_tree(
+                    slice_at(t, k), shrink=self.shrinkage_rate))
+        if t_eff < num_iters:
+            for k in range(k_stop):
+                self.models.append(learner.host_out_to_tree(
+                    slice_at(t_eff, k), shrink=self.shrinkage_rate))
         self.iter += t_eff
         if t_eff < num_iters:
-            # iterations after the first empty tree changed nothing (empty
-            # trees add zero score), so state is exactly "stopped at t_eff"
             Log.info("Stopped training because there are no more leafs "
                      "that meet the split requirements.")
+            if self.num_class == 1:
+                # iterations after the first empty tree changed nothing
+                # (empty trees add zero score): state is already exact
+                return True
+            # multiclass: classes after k_stop (and later iterations)
+            # kept learning inside the scan — rebuild scores from the
+            # kept trees so booster state matches the model list
+            self.train_score_updater = ScoreUpdater(self.train_data,
+                                                    self.num_class)
+            for i, tree in enumerate(self.models):
+                self.train_score_updater.add_score_by_tree(
+                    tree, i % self.num_class)
             return True
         return False
 

@@ -51,11 +51,18 @@ def _identity(x):
     return x
 
 
+def _collapse_pair(pair):
+    """Default hist reduction hook: no shards, just collapse the
+    compensated (value, residual) pair."""
+    hi, lo = pair
+    return hi + lo
+
+
 def build_tree_device(bins, grad, hess, inbag, feature_mask,
                       num_bin_pf, is_cat,
                       *, num_leaves, max_bin, params: SplitParams,
                       max_depth, row_chunk,
-                      hist_psum_fn=_identity, sum_psum_fn=_identity,
+                      hist_psum_fn=_collapse_pair, sum_psum_fn=_identity,
                       evaluate_fn=None, split_col_fn=None):
     """Grow one leaf-wise tree on device. All shapes static.
 
@@ -66,13 +73,22 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
       feature_mask: (F,) bool feature_fraction mask.
       num_bin_pf: (F,) int32 bins per feature; is_cat: (F,) bool.
       num_leaves/max_bin/params/max_depth/row_chunk: static config.
-      hist_psum_fn: reduces a (F, B, 3) histogram across row shards
-        (identity on a single device / feature-sharded learner).
-      sum_psum_fn: reduces scalar root sums across row shards.
+      hist_psum_fn: takes the compensated (hist, residual) pair from
+        masked_histograms and returns the reduced+collapsed (F, B, 3)
+        histogram. Default: collapse only (single device / feature-
+        sharded learner); the data-parallel learner reduces shard pairs
+        in a FIXED order so every shard (and the serial learner) sees
+        histograms equal to ~f64 accuracy — the reference gets the same
+        guarantee from f64 accumulators (bin.h:18-26).
+      sum_psum_fn: reduces scalar root sums across row shards. Root
+        sums are derived FROM the reduced histogram (any feature's bins
+        partition the rows), so learners whose hist_psum_fn already
+        produces the global histogram pass identity here.
       evaluate_fn: optional (hist3, sum_g, sum_h, cnt) -> SplitInfo
         override. `hist3` is the hist_psum_fn-reduced histogram for the
-        serial/data-parallel learners; the voting learner passes
-        hist_psum_fn=identity and does its own selective reduction here
+        serial/data-parallel learners; the voting learner keeps the
+        default pair-collapse (so hist3 is its LOCAL histogram) and does
+        its own selective reduction here
         (voting_parallel_tree_learner.cpp:137-293).
       split_col_fn: optional (feature_id) -> (N_pad,) int32 bin column,
         overridden by the feature-parallel learner to broadcast the
@@ -108,11 +124,14 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
                                  row_chunk)
 
     # ---- root ----------------------------------------------------------
-    root_g = sum_psum_fn(jnp.sum(g_in))
-    root_h = sum_psum_fn(jnp.sum(h_in))
-    root_c = sum_psum_fn(jnp.sum(inbag))
     row_leaf0 = jnp.zeros(n_pad, dtype=jnp.int32)
     hist_root = hist_psum_fn(leaf_histogram(row_leaf0, jnp.int32(0)))
+    # root sums from the reduced histogram: feature 0's bins partition
+    # the rows, so its bin sums ARE the leaf totals — this keeps parent
+    # sums bit-consistent with the histogram across serial/parallel
+    root_g = sum_psum_fn(jnp.sum(hist_root[0, :, 0]))
+    root_h = sum_psum_fn(jnp.sum(hist_root[0, :, 1]))
+    root_c = sum_psum_fn(jnp.sum(hist_root[0, :, 2]))
     root_split = scan_leaf(hist_root, root_g, root_h, root_c)
 
     def set0(arr, v):
@@ -303,8 +322,8 @@ class SerialTreeLearner:
             num_bin_pf = np.concatenate([num_bin_pf, np.ones(extra, np.int32)])
             is_cat = np.concatenate([is_cat, np.zeros(extra, bool)])
         self._bins = self._place_bins(bins)
-        self._num_bin_pf = jnp.asarray(num_bin_pf)
-        self._is_cat = jnp.asarray(is_cat)
+        self._num_bin_pf = self._place_rep(num_bin_pf)
+        self._is_cat = self._place_rep(is_cat)
         # host-side lookup tables for vectorized device->Tree conversion:
         # bin -> representative value per feature (Feature::BinToValue) and
         # the per-feature decision type, so _to_host_tree needs no Python
@@ -337,6 +356,10 @@ class SerialTreeLearner:
         return ((n + chunk - 1) // chunk) * chunk if n > chunk else n
 
     def _effective_chunk(self, chunk):
+        if jax.default_backend() == "tpu":
+            # rows are padded to HIST_CHUNK multiples; the XLA-fallback
+            # scan chunk must divide that
+            return min(chunk, HIST_CHUNK)
         return min(chunk, self.n_pad)
 
     def _pad_feature_count(self, f):
@@ -347,6 +370,18 @@ class SerialTreeLearner:
 
     def _place_rows(self, arr):
         return arr
+
+    def _place_rep(self, arr):
+        return jnp.asarray(arr)
+
+    def local_row_leaf(self, out, n_local):
+        """This process's rows of the row->leaf partition (trivial in
+        single-process; overridden by the meshed learners)."""
+        return out["row_leaf"][:n_local]
+
+    def local_leaf_values(self, out):
+        """Leaf values as a process-local array (overridden multi-host)."""
+        return out["leaf_value"]
 
     def _make_build_core(self, cfg, chunk):
         """The un-jitted builder closure — also consumed directly by the
@@ -405,7 +440,7 @@ class SerialTreeLearner:
         grad = self._place_rows(grad)
         hess = self._place_rows(hess)
         inbag = self._place_rows(inbag)
-        fmask = jnp.asarray(self._sample_features())
+        fmask = self._place_rep(self._sample_features())
         return self._build(self._bins, grad, hess, inbag, fmask,
                            self._num_bin_pf, self._is_cat)
 

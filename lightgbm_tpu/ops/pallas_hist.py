@@ -13,12 +13,15 @@ row->leaf map:
 
     hist[f, b, k] = sum_c [bins[f, c] == b] * [row_leaf[c] == leaf] * ghc[k, c]
 
-Per grid step (a row chunk C): bins (F, C) uint8, ghc (3, C) f32 and
+Per grid step (a row chunk C): bins (F, C) uint8, ghc (C, 3) f32 and
 row_leaf (1, C) int32 are DMA'd to VMEM (~(F+13)*C bytes — the one-hot
-never touches HBM), the mask multiplies ghc, and each feature does one
-(3, C) @ (C, B) MXU contraction accumulated into a VMEM-resident
-(F, 3, B) output. HBM traffic per histogram is bins + ghc + row_leaf
-(~44 MB at 1M rows), two orders of magnitude below the einsum path.
+never touches HBM). The one-hot is built as (B_pad, C): broadcasting
+the lane-resident bins row along SUBLANES is layout-native on the VPU
+(the (C, B) orientation would relayout lanes->sublanes per feature,
+measured 1.4x slower), and the (B_pad, C) @ (C, 3) dot is the natural
+MXU form. HBM traffic per histogram is bins + ghc + row_leaf (~44 MB at
+1M rows), two orders of magnitude below the einsum path; the kernel is
+VPU-compare-bound, not bandwidth- or MXU-bound.
 
 f32 operands give true f32 accumulation (better than XLA's default
 bfloat16 matmul passes); the count column comes out exactly integral.
@@ -31,9 +34,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# rows per grid step: the transient one-hot is (CHUNK, B_pad) f32 in
-# VMEM (2 MB at 2048 x 256); row padding must be a multiple of this.
-HIST_CHUNK = 2048
+# rows per grid step: the transient one-hot is (B_pad, CHUNK) f32 in
+# VMEM (4 MB at 256 x 4096); row padding must be a multiple of this.
+HIST_CHUNK = 4096
 
 
 def _hist_kernel(leaf_ref, bins_ref, ghc_ref, rl_ref, out_ref, *, f, b_pad):
@@ -43,16 +46,16 @@ def _hist_kernel(leaf_ref, bins_ref, ghc_ref, rl_ref, out_ref, *, f, b_pad):
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    mask = (rl_ref[0, :] == leaf_ref[0]).astype(jnp.float32)      # (C,)
-    ghc_m = ghc_ref[...] * mask[None, :]                          # (3, C)
     c = bins_ref.shape[1]
-    col_ids = jax.lax.broadcasted_iota(jnp.int32, (c, b_pad), 1)
+    mask = (rl_ref[0, :] == leaf_ref[0]).astype(jnp.float32)      # (C,) lanes
+    ghc_m = ghc_ref[...] * mask[:, None]                          # (C, 3)
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (b_pad, c), 0)
     for i in range(f):
-        onehot = (bins_ref[i, :].astype(jnp.int32)[:, None]
-                  == col_ids).astype(jnp.float32)                 # (C, B_pad)
+        onehot = (bins_ref[i, :].astype(jnp.int32)[None, :]
+                  == b_iota).astype(jnp.float32)                  # (B_pad, C)
         out_ref[i, :, :] += jax.lax.dot_general(
-            ghc_m, onehot, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            onehot, ghc_m, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                   # (B_pad, 3)
 
 
 def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total):
@@ -81,34 +84,45 @@ def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total):
             pl.BlockSpec(memory_space=pltpu.SMEM),  # leaf id (1,)
             pl.BlockSpec((f, HIST_CHUNK), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, HIST_CHUNK), lambda i: (0, i),
+            pl.BlockSpec((HIST_CHUNK, 3), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, HIST_CHUNK), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((f, 3, b_pad), lambda i: (0, 0, 0),
+        out_specs=pl.BlockSpec((f, b_pad, 3), lambda i: (0, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((f, 3, b_pad), jnp.float32),
-    )(jnp.asarray([leaf_id], dtype=jnp.int32), bins, ghc_t,
+        out_shape=jax.ShapeDtypeStruct((f, b_pad, 3), jnp.float32),
+    )(jnp.asarray([leaf_id], dtype=jnp.int32), bins, ghc_t.T,
       row_leaf.reshape(1, n))
-    return out.transpose(0, 2, 1)[:, :num_bins_total, :]
+    hist = out[:, :num_bins_total, :]
+    # plain f32 VMEM accumulation: the compensation slot is zero (the
+    # f32-vs-f64 parity guard in tests/test_hist_precision.py bounds the
+    # resulting error; TPU f64 emulation would forfeit the MXU)
+    return hist, jnp.zeros_like(hist)
 
 
 def masked_histograms_xla(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
                           row_chunk=HIST_CHUNK):
     """Reference XLA implementation (CPU tests / non-TPU backends): the
     chunked one-hot einsum of ops/histogram.py with the leaf mask folded
-    into the stats."""
-    from .histogram import build_histograms
+    into the stats. Returns a compensated (value, residual) pair."""
+    from .histogram import build_histograms_pair
     mask = (row_leaf == leaf_id).astype(jnp.float32)
     ghc = (ghc_t * mask[None, :]).T
-    return build_histograms(bins, ghc, num_bins_total, row_chunk)
+    return build_histograms_pair(bins, ghc, num_bins_total, row_chunk)
 
 
 def masked_histograms(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
                       row_chunk=HIST_CHUNK):
-    """Backend dispatch, resolved at trace time."""
-    if jax.default_backend() == "tpu":
+    """Backend dispatch, resolved at trace time. Returns (hist, residual):
+    collapse with `hist + residual`, or reduce the pair across shards in
+    a fixed order first (parallel/learners.py pair_allreduce).
+
+    LIGHTGBM_TPU_DISABLE_PALLAS=1 forces the XLA path on TPU (escape
+    hatch for kernel regressions; bench.py uses it as a fallback)."""
+    import os
+    if (jax.default_backend() == "tpu"
+            and not os.environ.get("LIGHTGBM_TPU_DISABLE_PALLAS")):
         return masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id,
                                      num_bins_total)
     return masked_histograms_xla(bins, ghc_t, row_leaf, leaf_id,

@@ -1,0 +1,140 @@
+"""Multi-host distributed runtime: membership + global mesh + placement.
+
+Reference: src/network/linkers_socket.cpp:20-207 (machine-list parsing,
+rank discovery, TCP handshake), src/network/network.cpp (Init), and the
+per-rank data distribution of src/io/dataset_loader.cpp:505-550.
+
+TPU-first design: membership and transport are `jax.distributed` —
+every process calls `initialize(coordinator, num_processes, rank)`, the
+mesh spans all global devices, and XLA routes the builder's `lax.psum`
+/ `all_gather` over ICI/DCN. The reference's hand-rolled Bruck /
+recursive-halving algorithms and socket linkers have no analog: topology
+and algorithm selection belong to the compiler. What remains of the
+reference's Network class is exactly this file: find my rank, connect,
+and expose helpers to build global arrays from per-rank data.
+
+Rank discovery mirrors linkers_socket.cpp:58-86: match a local
+hostname/IP against the machine list; the LIGHTGBM_TPU_RANK env var
+overrides (needed e.g. for multiple ranks on one host).
+"""
+
+import os
+import socket
+
+import jax
+import numpy as np
+
+from ..utils.log import Log
+
+_initialized = False
+
+
+def parse_machine_list(path):
+    """`ip port` (or `ip:port`) lines -> [(ip, port)] (linkers_socket.cpp:36-56)."""
+    machines = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip().replace(":", " ")
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) < 2:
+                Log.fatal("Machine list file parse error: %s", line)
+            machines.append((parts[0], int(parts[1])))
+    return machines
+
+
+def _local_addresses():
+    names = {"localhost", "127.0.0.1", socket.gethostname()}
+    try:
+        host, aliases, ips = socket.gethostbyname_ex(socket.gethostname())
+        names.update([host] + aliases + ips)
+    except OSError:
+        pass
+    return names
+
+
+def find_local_rank(machines):
+    """linkers_socket.cpp:58-86: my rank is the first machine-list entry
+    matching a local address."""
+    local = _local_addresses()
+    for i, (ip, _) in enumerate(machines):
+        if ip in local:
+            return i
+    Log.fatal("Machine list file doesn't contain the local machine")
+
+
+def init_from_config(config):
+    """Bring up jax.distributed from the reference's network config
+    (machine_list_file / num_machines, include/LightGBM/config.h:219-226).
+    No-op when already initialized or single-machine."""
+    global _initialized
+    if _initialized:
+        return False
+    if config is None or config.num_machines <= 1 or not config.machine_list_file:
+        return False
+    machines = parse_machine_list(config.machine_list_file)
+    if len(machines) < config.num_machines:
+        Log.fatal("Machine list file only contains %d machines, but "
+                  "num_machines is %d", len(machines), config.num_machines)
+    machines = machines[:config.num_machines]
+    env_rank = os.environ.get("LIGHTGBM_TPU_RANK")
+    rank = int(env_rank) if env_rank is not None else find_local_rank(machines)
+    coordinator = f"{machines[0][0]}:{machines[0][1]}"
+    try:
+        # NOTE: must run before anything initializes the XLA backend —
+        # do not touch jax.devices()/process_count() above this line
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=config.num_machines,
+                                   process_id=rank)
+    except RuntimeError as e:
+        # backend already up (e.g. running under an external launcher
+        # that initialized distributed itself) — keep going with it
+        Log.warning("jax.distributed.initialize skipped: %s", str(e))
+        return False
+    _initialized = True
+    Log.info("Distributed: rank %d of %d (coordinator %s), %d global devices",
+             rank, config.num_machines, coordinator, len(jax.devices()))
+    return True
+
+
+def process_rank():
+    return jax.process_index()
+
+
+def num_processes():
+    return jax.process_count()
+
+
+def is_multi_host():
+    return jax.process_count() > 1
+
+
+def place_global_rows(sharding, local_array):
+    """Assemble a row-sharded global array from each process's local
+    block (the analog of per-rank row storage, dataset_loader.cpp:505-550)."""
+    return jax.make_array_from_process_local_data(sharding, local_array)
+
+
+def place_replicated(sharding, full_array):
+    """Global array whose value every process holds fully (bin matrices
+    for feature-parallel, feature masks, per-feature tables)."""
+    full_array = np.asarray(full_array)
+    return jax.make_array_from_callback(
+        full_array.shape, sharding, lambda idx: full_array[idx])
+
+
+def partition_rows(n, rank, num_machines, query_boundaries=None):
+    """Contiguous per-rank row range, aligned to query boundaries so no
+    query is split (dataset_loader.cpp distributes rows; contiguous
+    blocks give identical global histograms, hence identical trees).
+    Returns (lo, hi)."""
+    if query_boundaries is not None:
+        qb = np.asarray(query_boundaries)
+        nq = len(qb) - 1
+        q_lo = (nq * rank) // num_machines
+        q_hi = (nq * (rank + 1)) // num_machines
+        return int(qb[q_lo]), int(qb[q_hi])
+    lo = (n * rank) // num_machines
+    hi = (n * (rank + 1)) // num_machines
+    return lo, hi

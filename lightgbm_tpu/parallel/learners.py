@@ -49,12 +49,43 @@ from ..utils.log import Log
 AXIS = "data"
 
 
+def pair_allreduce(pair, axis_name=AXIS):
+    """Deterministic cross-shard histogram reduction: all_gather both
+    components of the compensated (value, residual) pair, then Kahan-sum
+    the 2K components in a FIXED order identical on every shard. This is
+    the collective analog of the reference's f64 histogram Allreduce
+    (data_parallel_tree_learner.cpp:155-157 with bin.h:18-26 f64
+    accumulators): shard count and reduction topology cannot perturb the
+    result beyond ~1e-14 relative, so every rank applies the identical
+    best split."""
+    hi, lo = pair
+    ghi = jax.lax.all_gather(hi, axis_name)          # (K, F, B, 3)
+    glo = jax.lax.all_gather(lo, axis_name)
+    comps = jnp.concatenate([ghi, glo], axis=0)      # fixed order
+
+    def kstep(carry, x):
+        s, c = carry
+        y = x - c
+        t = s + y
+        return (t, (t - s) - y), None
+
+    zero = jnp.zeros_like(hi)
+    (s, c), _ = jax.lax.scan(kstep, (zero, zero), comps)
+    return s - c
+
+
 def make_mesh(config) -> Mesh:
-    """1-D device mesh. num_machines>1 limits the device count (so tests
-    can model the reference's `num_machines` param); default: all devices."""
+    """1-D device mesh.
+
+    Multi-host (jax.distributed initialized, parallel/distributed.py):
+    span ALL global devices — `num_machines` already chose the process
+    count. Single-process: num_machines>1 limits the device count so
+    tests can model the reference's `num_machines` param; default: all
+    local devices."""
     devs = jax.devices()
     n = len(devs)
-    if config is not None and getattr(config, "num_machines", 1) > 1:
+    if (jax.process_count() == 1 and config is not None
+            and getattr(config, "num_machines", 1) > 1):
         n = min(config.num_machines, len(devs))
     return Mesh(np.asarray(devs[:n]), (AXIS,))
 
@@ -67,7 +98,14 @@ _TREE_OUT_KEYS = (
 
 
 class _MeshedTreeLearner(SerialTreeLearner):
-    """Common mesh plumbing: pad/shard inputs, same host-side driver."""
+    """Common mesh plumbing: pad/shard inputs, same host-side driver.
+
+    Multi-host: the mesh spans all global devices; each process holds
+    only its row block of a row-sharded dataset (dataset_loader.cpp's
+    per-rank distribution) and global arrays are assembled from the
+    local blocks (parallel/distributed.py). Everything below the
+    placement layer — the builder, the collectives, the hooks — is
+    identical between 1 and N hosts."""
 
     # which input axes are sharded: "rows" or "features"
     shard_rows = True
@@ -76,29 +114,43 @@ class _MeshedTreeLearner(SerialTreeLearner):
     def init(self, train_set):
         self.mesh = make_mesh(self.config)
         self.n_shards = self.mesh.devices.size
+        self.n_proc = jax.process_count()
+        # per-rank loading records the global row count and the largest
+        # per-rank block (identical pad lengths on every rank require it)
+        self.global_num_data = getattr(train_set, "global_num_data", None) \
+            or train_set.num_data
+        self.local_rows_max = getattr(train_set, "local_rows_max", None)
         super().init(train_set)
-        Log.info("%s tree learner on %d devices", self.name, self.n_shards)
+        Log.info("%s tree learner on %d devices (%d processes)",
+                 self.name, self.n_shards, self.n_proc)
 
     # SerialTreeLearner.init calls these hooks -------------------------------
     def _pad_rows(self, n, chunk):
-        """Row padding must divide evenly into shards x chunks."""
+        """LOCAL row padding: every process pads its block to the same
+        length so shards divide evenly into chunks."""
         if not self.shard_rows:
             return super()._pad_rows(n, chunk)
-        k = self.n_shards
-        local = (n + k - 1) // k
+        d_local = max(1, self.n_shards // self.n_proc)
+        n_max = self.local_rows_max or -(-self.global_num_data // self.n_proc)
+        n_max = max(n_max, n)  # never pad below the local row count
+        shard = -(-n_max // d_local)
         if jax.default_backend() == "tpu":
             from ..ops.pallas_hist import HIST_CHUNK
-            local = ((local + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
-        elif local > chunk:
-            local = ((local + chunk - 1) // chunk) * chunk
-        return local * k
+            shard = ((shard + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
+        elif shard > chunk:
+            shard = ((shard + chunk - 1) // chunk) * chunk
+        return shard * d_local
 
     def _effective_chunk(self, chunk):
         if not self.shard_rows:
             return super()._effective_chunk(chunk)
+        if jax.default_backend() == "tpu":
+            from ..ops.pallas_hist import HIST_CHUNK
+            return min(chunk, HIST_CHUNK)
         # the scan chunk must divide the LOCAL shard length so the
         # (F, nchunks, chunk) reshape stays aligned with the row sharding
-        return min(chunk, self.n_pad // self.n_shards)
+        d_local = max(1, self.n_shards // self.n_proc)
+        return min(chunk, self.n_pad // d_local)
 
     def _pad_feature_count(self, f):
         if not self.shard_features:
@@ -117,10 +169,45 @@ class _MeshedTreeLearner(SerialTreeLearner):
         return NamedSharding(self.mesh, P())  # replicated
 
     def _place_bins(self, bins):
-        return jax.device_put(bins, self._bins_sharding())
+        sh = self._bins_sharding()
+        if self.n_proc > 1:
+            from .distributed import place_global_rows, place_replicated
+            if self.shard_rows:
+                return place_global_rows(sh, bins)
+            return place_replicated(sh, bins)
+        return jax.device_put(bins, sh)
 
     def _place_rows(self, arr):
-        return jax.device_put(arr, self._rows_sharding())
+        sh = self._rows_sharding()
+        if self.n_proc > 1:
+            from .distributed import place_global_rows, place_replicated
+            if self.shard_rows:
+                return place_global_rows(sh, np.asarray(arr))
+            return place_replicated(sh, np.asarray(arr))
+        return jax.device_put(arr, sh)
+
+    def _place_rep(self, arr):
+        """Replicated small arrays (masks, per-feature tables)."""
+        if self.n_proc > 1:
+            from .distributed import place_replicated
+            return place_replicated(NamedSharding(self.mesh, P()), arr)
+        return jnp.asarray(arr)
+
+    def local_row_leaf(self, out, n_local):
+        """This process's slice of the global row->leaf partition (for
+        the local score updater)."""
+        if self.n_proc == 1 or not self.shard_rows:
+            return out["row_leaf"][:n_local]
+        shards = sorted(out["row_leaf"].addressable_shards,
+                        key=lambda s: s.index[0].start)
+        # shards are committed to distinct local devices; assemble on host
+        return np.concatenate([np.asarray(s.data) for s in shards])[:n_local]
+
+    def local_leaf_values(self, out):
+        """Fully-replicated global -> local array (multi-host)."""
+        if self.n_proc == 1:
+            return out["leaf_value"]
+        return jnp.asarray(jax.device_get(out["leaf_value"]))
 
     def _out_specs(self):
         specs = {k: P() for k in _TREE_OUT_KEYS}
@@ -139,14 +226,16 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
         max_bin = self.max_bin
         params = self.params
         max_depth = int(cfg.max_depth)
-        psum = functools.partial(jax.lax.psum, axis_name=AXIS)
 
         def dp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
+            # hist pair-allreduce already yields the GLOBAL histogram on
+            # every shard, and root sums are derived from it — so the
+            # scalar-sum hook is identity
             return build_tree_device(
                 bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
-                hist_psum_fn=psum, sum_psum_fn=psum)
+                hist_psum_fn=pair_allreduce)
 
         return jax.shard_map(
             dp_fn, mesh=self.mesh,
@@ -175,6 +264,14 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
                   is_cat_full):
             shard = jax.lax.axis_index(AXIS)
 
+            def sum_bcast(s):
+                # root sums derive from each shard's LOCAL feature 0,
+                # whose bin-sum rounding differs per shard; broadcast
+                # shard 0's value so every shard evaluates splits with
+                # identical parent sums (matches the serial learner,
+                # which uses global feature 0)
+                return jax.lax.psum(jnp.where(shard == 0, s, 0.0), AXIS)
+
             def evaluate(hist3, sum_g, sum_h, cnt):
                 sp = find_best_split(hist3, sum_g, sum_h, cnt,
                                      num_bin_pf, is_cat, fmask, params)
@@ -199,6 +296,7 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
                 bins, grad, hess, inbag, fmask, num_bin_pf, is_cat_full,
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
+                sum_psum_fn=sum_bcast,
                 evaluate_fn=evaluate, split_col_fn=split_col)
 
         def wrapped7(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):

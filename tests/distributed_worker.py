@@ -1,0 +1,50 @@
+"""Worker process for the multi-host distributed test.
+
+Usage: python distributed_worker.py <rank> <mlist_file> <out_model>
+Env: LIGHTGBM_TPU_RANK, JAX_PLATFORMS=cpu,
+     XLA_FLAGS=--xla_force_host_platform_device_count=2
+"""
+
+import sys
+
+
+def main():
+    rank = int(sys.argv[1])
+    mlist = sys.argv[2]
+    out_model = sys.argv[3]
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.distributed import init_from_config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 15, "num_iterations": 5,
+        "tree_learner": "data", "num_machines": 2,
+        "machine_list_file": mlist, "min_data_in_leaf": 20,
+        "metric_freq": 0, "enable_load_from_binary_file": False,
+    })
+    init_from_config(cfg)
+
+    import jax
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    ds = DatasetLoader(cfg).load_from_file(
+        "/root/reference/examples/binary_classification/binary.train",
+        rank=jax.process_index(), num_machines=2)
+    assert ds.global_num_data == 7000, ds.global_num_data
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    b = GBDT()
+    b.init(cfg, ds, obj, [])
+    for _ in range(cfg.num_iterations):
+        b.train_one_iter(is_eval=False)
+    if rank == 0:
+        b.save_model_to_file(-1, out_model)
+    print("WORKER_DONE rank", rank, flush=True)
+
+
+if __name__ == "__main__":
+    main()

