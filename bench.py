@@ -159,12 +159,17 @@ def measure(n_rows, timeout_s, force_cpu=False, disable_pallas=False):
     return None, f"rc={r.returncode}: {tail}"
 
 
-def measure_with_fallback(n_rows, timeout_s, on_cpu_backend):
-    """TPU pallas -> TPU XLA -> CPU ladder."""
+def measure_with_fallback(n_rows, timeout_s, on_cpu_backend, start_at=None):
+    """TPU pallas -> TPU XLA -> CPU ladder. `start_at` skips rungs a
+    previous measurement already proved dead."""
     attempts = ([("cpu", dict(force_cpu=True))] if on_cpu_backend else
                 [("tpu-pallas", {}),
                  ("tpu-xla", dict(disable_pallas=True)),
                  ("cpu", dict(force_cpu=True))])
+    if start_at is not None:
+        names = [n for n, _ in attempts]
+        if start_at in names:
+            attempts = attempts[names.index(start_at):]
     notes = []
     for name, kw in attempts:
         res, note = measure(n_rows, timeout_s, **kw)
@@ -210,7 +215,8 @@ def main():
     # but not if even the 1M run had to fall back to CPU.
     if (not on_cpu and "error" not in res and res.get("path") != "cpu"
             and not os.environ.get("BENCH_SKIP_HIGGS")):
-        hres = measure_with_fallback(11_000_000, HIGGS_TIMEOUT_S, False)
+        hres = measure_with_fallback(11_000_000, HIGGS_TIMEOUT_S, False,
+                                     start_at=res.get("path"))
         if "error" in hres:
             result["higgs_11M_error"] = hres["error"][-200:]
         else:

@@ -1,0 +1,174 @@
+"""Exclusive feature bundling: sparse columns share dense bin slots.
+
+Reference capability being replaced: src/io/sparse_bin.hpp:17-331 and
+ordered_sparse_bin.hpp:25-133 store sparse features as (index, bin)
+pairs, auto-selected at sparse_rate >= 0.8 (src/io/bin.cpp:291-302).
+Those are CPU pointer-chasing layouts; on TPU the histogram kernel
+wants one dense integer matrix. Instead of storing a mostly-zero dense
+row per sparse feature, mutually-exclusive sparse features are BUNDLED
+into one shared row: member i's nonzero bins 1..nb_i-1 occupy the slot
+range [off_i+1, off_i+nb_i-1], slot bin 0 means "every member at its
+zero bin". A 10^4-column one-hot-ish dataset collapses to tens of
+stored rows, shrinking both HBM and histogram passes by the same
+factor.
+
+Training stays EXACT for conflict-free bundles: the (S, B, 3) stored
+histogram expands to per-feature virtual histograms by gathers (member
+ranges) plus a subtraction for bin 0 (slot total minus member range —
+exclusivity puts every other member's row at the member's zero bin),
+and the split scan / model see only ORIGINAL feature ids. Rows that
+violate exclusivity (conflicts) keep the first member's bin, the same
+tolerance as the greedy bundling literature; planning happens on the
+binning sample and conflicts are counted + logged during the full pass.
+"""
+
+import numpy as np
+
+from ..utils.log import Log
+
+SPARSE_THRESHOLD = 0.8   # bin.cpp:291-302 auto-sparse threshold
+MAX_SLOT_BINS = 256      # keep stored histogram width = one bin tile
+
+
+class BundlePlan:
+    """Static description: stored slot + bin offset per virtual feature."""
+
+    def __init__(self, feat_slot, feat_offset, slot_bins, num_slots):
+        self.feat_slot = np.asarray(feat_slot, dtype=np.int32)      # (F,)
+        self.feat_offset = np.asarray(feat_offset, dtype=np.int32)  # (F,)
+        self.slot_bins = np.asarray(slot_bins, dtype=np.int32)      # (S,)
+        self.num_slots = int(num_slots)
+
+    @property
+    def is_identity(self):
+        return self.num_slots == len(self.feat_slot) and \
+            bool((self.feat_offset == 0).all())
+
+    def to_dict(self):
+        return {"feat_slot": self.feat_slot, "feat_offset": self.feat_offset,
+                "slot_bins": self.slot_bins,
+                "num_slots": np.asarray(self.num_slots)}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["feat_slot"], d["feat_offset"], d["slot_bins"],
+                   int(d["num_slots"]))
+
+
+def plan_bundles(mappers, sample_bins, enable=True):
+    """Greedy conflict-free bundling on the binning sample.
+
+    Args:
+      mappers: per (used) feature BinMapper.
+      sample_bins: (F, S_rows) int bins of the sample rows.
+      enable: config is_enable_sparse.
+
+    Returns a BundlePlan (identity when nothing bundles).
+    """
+    f = len(mappers)
+    identity = BundlePlan(np.arange(f), np.zeros(f, np.int32),
+                          [m.num_bin for m in mappers], f)
+    if not enable or f == 0:
+        return identity
+
+    candidates = []
+    for j, m in enumerate(mappers):
+        # numerical, zero maps to bin 0, genuinely sparse
+        if (m.bin_type == 0 and m.sparse_rate >= SPARSE_THRESHOLD
+                and int(m.value_to_bin(np.zeros(1))[0]) == 0):
+            candidates.append(j)
+    if len(candidates) < 2:
+        return identity
+
+    nnz = {j: np.count_nonzero(sample_bins[j]) for j in candidates}
+    order = sorted(candidates, key=lambda j: -nnz[j])
+    bundles = []   # list of (member list, occupied bool rows, used bins)
+    for j in order:
+        col_nz = sample_bins[j] > 0
+        nb = mappers[j].num_bin
+        placed = False
+        for b in bundles:
+            members, occupied, used = b
+            if used + (nb - 1) > MAX_SLOT_BINS - 1:
+                continue
+            if np.any(occupied & col_nz):
+                continue
+            members.append(j)
+            b[1] = occupied | col_nz
+            b[2] = used + (nb - 1)
+            placed = True
+            break
+        if not placed:
+            bundles.append([[j], col_nz.copy(), nb - 1])
+
+    bundles = [b for b in bundles if len(b[0]) >= 2]
+    if not bundles:
+        return identity
+
+    bundled = set()
+    feat_slot = np.zeros(f, np.int32)
+    feat_offset = np.zeros(f, np.int32)
+    slot_bins = []
+    slot_id = 0
+    for members, _, _ in bundles:
+        off = 0
+        for j in members:
+            bundled.add(j)
+            feat_slot[j] = slot_id
+            feat_offset[j] = off
+            off += mappers[j].num_bin - 1
+        slot_bins.append(off + 1)
+        slot_id += 1
+    for j in range(f):
+        if j not in bundled:
+            feat_slot[j] = slot_id
+            feat_offset[j] = 0
+            slot_bins.append(mappers[j].num_bin)
+            slot_id += 1
+    Log.info("Bundled %d sparse features into %d slots (%d stored rows "
+             "for %d features)", len(bundled), len(bundles), slot_id, f)
+    return BundlePlan(feat_slot, feat_offset, slot_bins, slot_id)
+
+
+def build_stored_matrix(plan, bin_cols, dtype):
+    """Full-data pass: write per-feature bin columns into their slots.
+    `bin_cols(j)` -> (N,) int bins of virtual feature j. Conflicting rows
+    keep the first member's bin (greedy-EFB tolerance)."""
+    f = len(plan.feat_slot)
+    n = len(bin_cols(0))
+    stored = np.zeros((plan.num_slots, n), dtype=dtype)
+    conflicts = 0
+    for j in range(f):
+        s = plan.feat_slot[j]
+        off = plan.feat_offset[j]
+        col = bin_cols(j)
+        nz = col > 0
+        taken = stored[s] > 0
+        clash = nz & taken
+        conflicts += int(clash.sum())
+        write = nz & ~taken
+        stored[s, write] = (col[write] + off).astype(dtype)
+    if conflicts:
+        Log.warning("Feature bundling: %d conflicting cells kept their "
+                    "first member's bin", conflicts)
+    return stored
+
+
+def expansion_maps(plan, mappers, b_virtual):
+    """Static gather maps for stored->virtual histogram expansion.
+
+    Returns (src_idx (F, b_virtual) int32 into the flattened
+    (S*B_stored (+1 zero pad),) stored histogram, slot_of (F,)):
+      hist_v[f, b] = hist_s_flat[src_idx[f, b]]        for b >= 1
+      hist_v[f, 0] = slot_total[slot_of[f]] - sum_b>=1 hist_v[f, b]
+    """
+    f = len(plan.feat_slot)
+    b_stored = int(plan.slot_bins.max())
+    pad = plan.num_slots * b_stored  # index of an always-zero pad cell
+    src = np.full((f, b_virtual), pad, dtype=np.int32)
+    for j in range(f):
+        nb = mappers[j].num_bin
+        s, off = plan.feat_slot[j], plan.feat_offset[j]
+        for b in range(1, nb):
+            src[j, b] = s * b_stored + off + b
+    return src, plan.feat_slot.copy()

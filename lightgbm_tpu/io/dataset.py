@@ -43,6 +43,25 @@ def _qid_to_counts(qid_col):
     return np.diff(edges)
 
 
+class _VirtualBinsView:
+    """Fancy-indexable [feat_arr, row_arr] view over a bundled stored
+    matrix (host traversal path; see io/bundling.py for the encoding)."""
+
+    def __init__(self, stored, plan, num_bin_pf):
+        self._stored = stored
+        self._plan = plan
+        self._nb = np.asarray(num_bin_pf)
+        self.shape = (len(plan.feat_slot), stored.shape[1])
+
+    def __getitem__(self, key):
+        feat, rows = key
+        feat = np.asarray(feat)
+        sc = self._stored[self._plan.feat_slot[feat], rows].astype(np.int64)
+        off = self._plan.feat_offset[feat]
+        nb = self._nb[feat]
+        return np.where((sc > off) & (sc <= off + nb - 1), sc - off, 0)
+
+
 class CoreDataset:
     """Eagerly-binned dataset (the reference's `Dataset`, dataset.h:278-421)."""
 
@@ -58,6 +77,7 @@ class CoreDataset:
         self._device_bins = None
         self.raw_data = None          # optional (N, C) float32 original values
         self.global_num_data = None   # set by per-rank loading (multi-host)
+        self.bundle_plan = None       # io/bundling.py BundlePlan or None
 
     # ------------------------------------------------------------ properties
     @property
@@ -71,6 +91,22 @@ class CoreDataset:
     @property
     def max_num_bin(self):
         return max((m.num_bin for m in self.bin_mappers), default=1)
+
+    @property
+    def max_stored_bin(self):
+        """Histogram width of the STORED matrix (bundle slots can pack
+        several features' bin ranges into one row)."""
+        if self.bundle_plan is None:
+            return self.max_num_bin
+        return int(self.bundle_plan.slot_bins.max())
+
+    def traversal_bins(self):
+        """Bins indexable as [feature_array, row_array] in VIRTUAL feature
+        space for host tree traversal; decodes bundle slots on the fly."""
+        if self.bundle_plan is None:
+            return self.bins
+        return _VirtualBinsView(self.bins, self.bundle_plan,
+                                self.num_bin_array())
 
     def num_bin_array(self):
         return np.asarray([m.num_bin for m in self.bin_mappers], dtype=np.int32)
@@ -106,6 +142,7 @@ class CoreDataset:
         out.feature_names = self.feature_names
         out.num_total_features = self.num_total_features
         out.label_idx = self.label_idx
+        out.bundle_plan = self.bundle_plan
         out.metadata = self.metadata.subset(indices)
         if self.raw_data is not None:
             out.raw_data = self.raw_data[indices]
@@ -125,6 +162,9 @@ class CoreDataset:
         for i, m in enumerate(self.bin_mappers):
             for k, v in m.to_dict().items():
                 arrays[f"mapper{i}_{k}"] = np.asarray(v)
+        if self.bundle_plan is not None:
+            for k, v in self.bundle_plan.to_dict().items():
+                arrays[f"bundle_{k}"] = np.asarray(v)
         for k, v in self.metadata.to_dict().items():
             arrays[f"meta_{k}"] = np.asarray(v)
         with open(path, "wb") as f:  # keep the exact path (savez appends .npz)
@@ -149,6 +189,10 @@ class CoreDataset:
             d = {k[len(f"mapper{i}_"):]: z[k] for k in z.files
                  if k.startswith(f"mapper{i}_")}
             ds.bin_mappers.append(BinMapper.from_dict(d))
+        bundle = {k[7:]: z[k] for k in z.files if k.startswith("bundle_")}
+        if bundle:
+            from .bundling import BundlePlan
+            ds.bundle_plan = BundlePlan.from_dict(bundle)
         meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
         ds.metadata = Metadata.from_dict(meta)
         return ds
@@ -175,6 +219,13 @@ class DatasetLoader:
                 # (config.cpp:173-176, application.cpp:125-131)
                 or self.config.tree_learner == "feature"):
             return ds
+        if jax.process_count() != num_machines:
+            Log.fatal("num_machines=%d but %d jax processes are running; "
+                      "the row partition would drop data",
+                      num_machines, jax.process_count())
+        if rank >= num_machines:
+            Log.fatal("rank %d out of range for num_machines=%d",
+                      rank, num_machines)
         from ..parallel.distributed import partition_rows
         n = ds.num_data
         qb = ds.metadata.query_boundaries
@@ -207,6 +258,15 @@ class DatasetLoader:
                     ds = CoreDataset.load_binary(cand)
                 except Exception:
                     continue  # not a binary cache; fall through
+                if ds.bundle_plan is not None and (
+                        not cfg.is_enable_sparse
+                        or cfg.tree_learner == "feature"):
+                    # cache was built with bundling but this run can't
+                    # use it — rebuild from text instead of fataling
+                    Log.warning("Binary cache %s contains a bundled "
+                                "dataset incompatible with this config; "
+                                "rebuilding from text", cand)
+                    break
                 Log.info("Loaded binary dataset %s", cand)
                 self._attach_init_score(ds)
                 return self._apply_rank_partition(ds, rank, num_machines)
@@ -217,8 +277,8 @@ class DatasetLoader:
         # in-memory path.
         if cfg.use_two_round_loading and self.predict_fun is None:
             ds = self._load_two_round(filename)
-            if cfg.is_save_binary_file:
-                ds.save_binary(bin_path)
+            if cfg.is_save_binary_file and rank == 0:
+                ds.save_binary(bin_path)  # one writer on shared storage
             return self._apply_rank_partition(ds, rank, num_machines)
 
         label, feats, names, fmt, label_idx = parse_text_file(
@@ -244,8 +304,8 @@ class DatasetLoader:
         if self.predict_fun is not None:
             ds.raw_data = feats  # continued training needs raw values
         self._attach_init_score(ds)
-        if cfg.is_save_binary_file:
-            ds.save_binary(bin_path)
+        if cfg.is_save_binary_file and rank == 0:
+            ds.save_binary(bin_path)  # one writer on shared storage
         return self._apply_rank_partition(ds, rank, num_machines)
 
     def load_from_file_align_with_other_dataset(self, filename, train_ds) -> CoreDataset:
@@ -312,9 +372,27 @@ class DatasetLoader:
         mappers, used_map, real_idx = self._make_mappers(
             sample_feats, num_feats, ignore, categorical)
 
+        # bundling plan from the sample — identical to the in-memory
+        # path's (same sample rows, same greedy pass)
+        from .bundling import plan_bundles
+        plan = None
+        if cfg.is_enable_sparse and cfg.tree_learner != "feature":
+            sample_bins = np.stack(
+                [mappers[used_map[j]].value_to_bin(sample_feats[:, j])
+                 for j in real_idx], axis=0)
+            plan = plan_bundles(mappers, sample_bins, enable=True)
+            if plan.is_identity:
+                plan = None
+
         # round two: stream blocks, pushing binned values + metadata columns
-        dtype = np.uint8 if max(m.num_bin for m in mappers) <= 256 else np.uint16
-        bins = np.empty((len(mappers), n), dtype=dtype)
+        if plan is None:
+            dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
+                     else np.uint16)
+            bins = np.empty((len(mappers), n), dtype=dtype)
+        else:
+            dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
+                     else np.uint16)
+            bins = np.zeros((plan.num_slots, n), dtype=dtype)
         label = np.empty(n, dtype=np.float32)
         weights = np.empty(n, dtype=np.float32) if weight_idx >= 0 else None
         qid = np.empty(n, dtype=np.float64) if group_idx >= 0 else None
@@ -328,14 +406,22 @@ class DatasetLoader:
             if qid is not None:
                 qid[start:end] = feats_block[:, group_idx]
             for u, j in enumerate(real_idx):
-                bins[u, start:end] = mappers[u].value_to_bin(
-                    feats_block[:, j]).astype(dtype)
+                col = mappers[u].value_to_bin(feats_block[:, j])
+                if plan is None:
+                    bins[u, start:end] = col.astype(dtype)
+                else:
+                    s = plan.feat_slot[u]
+                    off = plan.feat_offset[u]
+                    seg = bins[s, start:end]
+                    write = (col > 0) & (seg == 0)
+                    seg[write] = (col[write] + off).astype(dtype)
 
         ds = CoreDataset()
         ds.num_total_features = num_feats
         ds.feature_names = (list(feat_names) if feat_names is not None
                             else [f"Column_{i}" for i in range(num_feats)])
         ds.bins = bins
+        ds.bundle_plan = plan
         ds.bin_mappers = mappers
         ds.used_feature_map = used_map
         ds.real_feature_idx = np.asarray(real_idx, dtype=np.int32)
@@ -434,6 +520,7 @@ class DatasetLoader:
     def _construct(self, feats, names, ignore, categorical, meta) -> CoreDataset:
         """Bin-mapper construction + feature extraction
         (ConstructBinMappersFromTextData + ExtractFeatures, dataset_loader.cpp:612-841)."""
+        cfg = self.config
         n, num_total = feats.shape
         sample_idx = self._sample_rows(n)
         sample = feats[sample_idx]
@@ -445,10 +532,33 @@ class DatasetLoader:
 
         mappers, used_map, real_idx = self._make_mappers(
             sample, num_total, ignore, categorical)
-        dtype = np.uint8 if max(m.num_bin for m in mappers) <= 256 else np.uint16
-        ds.bins = np.stack(
-            [mappers[used_map[j]].value_to_bin(feats[:, j]).astype(dtype)
-             for j in real_idx], axis=0)
+
+        # exclusive feature bundling: sparse columns share dense slots
+        # (io/bundling.py; replaces the reference's sparse_bin storage)
+        from .bundling import plan_bundles, build_stored_matrix
+        plan = None
+        if cfg.is_enable_sparse and cfg.tree_learner != "feature":
+            sample_bins = np.stack(
+                [mappers[used_map[j]].value_to_bin(sample[:, j])
+                 for j in real_idx], axis=0)
+            plan = plan_bundles(mappers, sample_bins, enable=True)
+            if plan.is_identity:
+                plan = None
+
+        if plan is None:
+            dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
+                     else np.uint16)
+            ds.bins = np.stack(
+                [mappers[used_map[j]].value_to_bin(feats[:, j]).astype(dtype)
+                 for j in real_idx], axis=0)
+        else:
+            dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
+                     else np.uint16)
+            ds.bins = build_stored_matrix(
+                plan,
+                lambda u: mappers[u].value_to_bin(feats[:, real_idx[u]]),
+                dtype)
+            ds.bundle_plan = plan
         ds.bin_mappers = mappers
         ds.used_feature_map = used_map
         ds.real_feature_idx = np.asarray(real_idx, dtype=np.int32)

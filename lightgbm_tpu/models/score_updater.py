@@ -94,11 +94,11 @@ class ScoreUpdater:
 
     def add_score_by_tree(self, tree, curr_class):
         """Host bin-space traversal (re-scoring loaded/materialized models)."""
-        vals = tree.predict_by_bins(self.dataset.bins).astype(np.float32)
+        vals = tree.predict_by_bins(self.dataset.traversal_bins()).astype(np.float32)
         self.score = self.score.at[curr_class].add(jnp.asarray(vals))
 
     def sub_score_by_tree(self, tree, curr_class):
-        vals = tree.predict_by_bins(self.dataset.bins).astype(np.float32)
+        vals = tree.predict_by_bins(self.dataset.traversal_bins()).astype(np.float32)
         self.score = self.score.at[curr_class].add(jnp.asarray(-vals))
 
     def sub_score_by_trees(self, trees, num_class):
@@ -106,7 +106,7 @@ class ScoreUpdater:
         ONE device update total (used by early-stopping truncation)."""
         delta = np.zeros((self.num_class, self.num_data), dtype=np.float32)
         for i, tree in enumerate(trees):
-            delta[i % num_class] -= tree.predict_by_bins(self.dataset.bins)
+            delta[i % num_class] -= tree.predict_by_bins(self.dataset.traversal_bins())
         self.score = self.score + jnp.asarray(delta)
 
     def host_score(self):

@@ -63,7 +63,8 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
                       *, num_leaves, max_bin, params: SplitParams,
                       max_depth, row_chunk,
                       hist_psum_fn=_collapse_pair, sum_psum_fn=_identity,
-                      evaluate_fn=None, split_col_fn=None):
+                      evaluate_fn=None, split_col_fn=None,
+                      expand_fn=_identity):
     """Grow one leaf-wise tree on device. All shapes static.
 
     Args:
@@ -92,7 +93,12 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
         (voting_parallel_tree_learner.cpp:137-293).
       split_col_fn: optional (feature_id) -> (N_pad,) int32 bin column,
         overridden by the feature-parallel learner to broadcast the
-        owner shard's column.
+        owner shard's column, and by bundled datasets to decode a
+        virtual feature out of its slot.
+      expand_fn: stored->virtual histogram expansion for bundled
+        datasets (io/bundling.py); identity otherwise. Histograms are
+        cached and subtracted in STORED space (cheap), expanded only at
+        split evaluation.
 
     Returns a dict of tree arrays + the final row->leaf partition.
     """
@@ -105,7 +111,9 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
         def evaluate_fn(hist3, sum_g, sum_h, cnt):
             return find_best_split(hist3, sum_g, sum_h, cnt,
                                    num_bin_pf, is_cat, feature_mask, params)
-    scan_leaf = evaluate_fn
+
+    def scan_leaf(hist3, sum_g, sum_h, cnt):
+        return evaluate_fn(expand_fn(hist3), sum_g, sum_h, cnt)
 
     if split_col_fn is None:
         def split_col_fn(feat):
@@ -301,7 +309,18 @@ class SerialTreeLearner:
         cfg = self.config
         self.num_features = train_set.num_features
         self.num_data = train_set.num_data
-        self.max_bin = int(train_set.max_num_bin)
+        # histogram width follows the STORED matrix (bundle slots pack
+        # several features' bin ranges; io/bundling.py)
+        self.max_bin = int(train_set.max_stored_bin)
+        self._bundle = train_set.bundle_plan
+        if self._bundle is not None:
+            from ..io.bundling import expansion_maps
+            src, slot_of = expansion_maps(self._bundle, train_set.bin_mappers,
+                                          int(train_set.max_num_bin))
+            self._bundle_src = self._place_rep(src)
+            self._bundle_slot_of = self._place_rep(slot_of)
+            self._bundle_feat_slot = self._place_rep(self._bundle.feat_slot)
+            self._bundle_feat_off = self._place_rep(self._bundle.feat_offset)
         chunk = int(cfg.device_row_chunk)
         n_pad = self._pad_rows(self.num_data, chunk)
         self.n_pad = n_pad
@@ -383,11 +402,40 @@ class SerialTreeLearner:
         """Leaf values as a process-local array (overridden multi-host)."""
         return out["leaf_value"]
 
+    def _bundle_kwargs(self, bins, num_bin_pf):
+        """Bundled-dataset hooks for build_tree_device: stored->virtual
+        histogram expansion + slot-decoding split columns. Shared with
+        the row-sharded parallel learners (parallel/learners.py)."""
+        if getattr(self, "_bundle", None) is None:
+            return {}
+        src = self._bundle_src
+        slot_of = self._bundle_slot_of
+        fslot = self._bundle_feat_slot
+        foff = self._bundle_feat_off
+
+        def split_col(feat):
+            sc = jnp.take(bins, fslot[feat], axis=0).astype(jnp.int32)
+            off = foff[feat]
+            nb = num_bin_pf[feat]
+            return jnp.where((sc > off) & (sc <= off + nb - 1), sc - off, 0)
+
+        def expand(h):
+            k = h.shape[-1]
+            flat = jnp.concatenate(
+                [h.reshape(-1, k), jnp.zeros((1, k), h.dtype)], axis=0)
+            hv = jnp.take(flat, src, axis=0)                 # (F, B_v, 3)
+            slot_tot = jnp.sum(h, axis=1)                    # (S, 3)
+            hv0 = (jnp.take(slot_tot, slot_of, axis=0)
+                   - jnp.sum(hv[:, 1:, :], axis=1))
+            return hv.at[:, 0, :].set(hv0)
+
+        return {"expand_fn": expand, "split_col_fn": split_col}
+
     def _make_build_core(self, cfg, chunk):
         """The un-jitted builder closure — also consumed directly by the
         fused multi-iteration trainer (models/gbdt.py train_many), which
         embeds it inside its own scanned program."""
-        return functools.partial(
+        base = functools.partial(
             build_tree_device,
             num_leaves=int(cfg.num_leaves),
             max_bin=self.max_bin,
@@ -395,6 +443,13 @@ class SerialTreeLearner:
             max_depth=int(cfg.max_depth),
             row_chunk=chunk,
         )
+        if getattr(self, "_bundle", None) is None:
+            return base
+
+        def bundled(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
+            return base(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
+                        **self._bundle_kwargs(bins, num_bin_pf))
+        return bundled
 
     def _make_build_fn(self, cfg, chunk):
         self._build_core = self._make_build_core(cfg, chunk)

@@ -235,7 +235,8 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
                 bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
-                hist_psum_fn=pair_allreduce)
+                hist_psum_fn=pair_allreduce,
+                **self._bundle_kwargs(bins, num_bin_pf))
 
         return jax.shard_map(
             dp_fn, mesh=self.mesh,
@@ -252,6 +253,12 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
     name = "feature"
     shard_rows = False
     shard_features = True
+
+    def init(self, train_set):
+        if train_set.bundle_plan is not None:
+            Log.fatal("feature-parallel does not support bundled datasets; "
+                      "set is_enable_sparse=false")
+        super().init(train_set)
 
     def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
@@ -373,7 +380,8 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
-                sum_psum_fn=psum, evaluate_fn=evaluate)
+                sum_psum_fn=psum, evaluate_fn=evaluate,
+                **self._bundle_kwargs(bins, num_bin_pf))
 
         return jax.shard_map(
             voting_fn, mesh=self.mesh,

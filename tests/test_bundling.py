@@ -1,0 +1,102 @@
+"""Exclusive feature bundling: storage shrinks, trees stay identical.
+
+Reference capability being replaced: sparse bin storage
+(src/io/sparse_bin.hpp:17-331, auto-selected at sparse_rate >= 0.8,
+src/io/bin.cpp:291-302). See io/bundling.py for the TPU-first encoding.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import DatasetLoader
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+
+
+@pytest.fixture(scope="module")
+def sparse_data():
+    """3 one-hot indicator groups of 12 columns each (mutually exclusive
+    within a group by construction, 2 bins per column — the classic EFB
+    shape) + 4 dense columns."""
+    rng = np.random.RandomState(7)
+    n = 3000
+    cols = []
+    for g in range(3):
+        idx = rng.randint(0, 12, size=n)
+        onehot = np.zeros((n, 12), np.float32)
+        onehot[np.arange(n), idx] = 1.0
+        cols.append(onehot)
+    dense = rng.randn(n, 4).astype(np.float32)
+    x = np.concatenate(cols + [dense], axis=1)
+    logit = (x[:, 0] + x[:, 12] - x[:, 24] + 0.5 * dense[:, 0]
+             + 0.3 * rng.randn(n))
+    y = (logit > 0.4).astype(np.float32)
+    return x, y
+
+
+def _train(x, y, enable_sparse, learner="serial", rounds=6):
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 15, "min_data_in_leaf": 10,
+        "num_iterations": rounds, "metric_freq": 0,
+        "is_enable_sparse": enable_sparse, "tree_learner": learner,
+        "device_row_chunk": 512,
+    })
+    ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    b = GBDT()
+    b.init(cfg, ds, obj, [])
+    for _ in range(rounds):
+        b.train_one_iter(is_eval=False)
+    return b, ds
+
+
+def test_bundles_shrink_storage(sparse_data):
+    x, y = sparse_data
+    _, ds = _train(x, y, enable_sparse=True, rounds=1)
+    assert ds.bundle_plan is not None
+    # 36 sparse one-hot columns pack into few slots; 4 dense stay separate
+    assert ds.bins.shape[0] <= 10, ds.bins.shape
+    assert ds.num_features == 40  # virtual features unchanged
+
+
+def test_bundled_training_matches_unbundled(sparse_data):
+    x, y = sparse_data
+    b1, _ = _train(x, y, enable_sparse=False)
+    b2, _ = _train(x, y, enable_sparse=True)
+    assert len(b1.models) == len(b2.models)
+    for t1, t2 in zip(b1.models, b2.models):
+        assert t1.num_leaves == t2.num_leaves
+        np.testing.assert_array_equal(t1.split_feature_real,
+                                      t2.split_feature_real)
+        np.testing.assert_array_equal(t1.threshold_in_bin, t2.threshold_in_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-5, atol=1e-7)
+    p1 = b1.predict(x)[:, 0]
+    p2 = b2.predict(x)[:, 0]
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+def test_bundled_data_parallel(sparse_data):
+    x, y = sparse_data
+    b1, _ = _train(x, y, enable_sparse=True, learner="serial")
+    b2, _ = _train(x, y, enable_sparse=True, learner="data")
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature_real,
+                                      t2.split_feature_real)
+        np.testing.assert_array_equal(t1.threshold_in_bin, t2.threshold_in_bin)
+
+
+def test_virtual_bins_view_matches_unbundled(sparse_data):
+    x, y = sparse_data
+    cfg = Config.from_params({"is_enable_sparse": True})
+    cfg2 = Config.from_params({"is_enable_sparse": False})
+    d1 = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    d2 = DatasetLoader(cfg2).construct_from_matrix(x, label=y)
+    assert d1.bundle_plan is not None and d2.bundle_plan is None
+    view = d1.traversal_bins()
+    rows = np.arange(d1.num_data)
+    for f in range(0, d1.num_features, 7):
+        feats = np.full(len(rows), f)
+        np.testing.assert_array_equal(view[feats, rows], d2.bins[f, rows])
