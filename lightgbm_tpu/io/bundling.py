@@ -135,13 +135,14 @@ def build_stored_matrix(plan, bin_cols, dtype):
     `bin_cols(j)` -> (N,) int bins of virtual feature j. Conflicting rows
     keep the first member's bin (greedy-EFB tolerance)."""
     f = len(plan.feat_slot)
-    n = len(bin_cols(0))
+    col0 = bin_cols(0)
+    n = len(col0)
     stored = np.zeros((plan.num_slots, n), dtype=dtype)
     conflicts = 0
     for j in range(f):
         s = plan.feat_slot[j]
         off = plan.feat_offset[j]
-        col = bin_cols(j)
+        col = col0 if j == 0 else bin_cols(j)
         nz = col > 0
         taken = stored[s] > 0
         clash = nz & taken

@@ -248,6 +248,7 @@ class DatasetLoader:
         # the binary cache stores no raw values, which continued training
         # needs for init scores — fall back to the text path then
         use_cache = cfg.enable_load_from_binary_file and self.predict_fun is None
+        cache_incompatible = False
         # CheckCanLoadFromBin (dataset_loader.cpp:903-940): the data path
         # may BE a binary cache file, or have a sibling <data>.bin cache.
         if use_cache:
@@ -262,10 +263,12 @@ class DatasetLoader:
                         not cfg.is_enable_sparse
                         or cfg.tree_learner == "feature"):
                     # cache was built with bundling but this run can't
-                    # use it — rebuild from text instead of fataling
+                    # use it — rebuild from text (WITHOUT overwriting the
+                    # cache, so the original config keeps its bundling)
                     Log.warning("Binary cache %s contains a bundled "
                                 "dataset incompatible with this config; "
                                 "rebuilding from text", cand)
+                    cache_incompatible = True
                     break
                 Log.info("Loaded binary dataset %s", cand)
                 self._attach_init_score(ds)
@@ -277,7 +280,7 @@ class DatasetLoader:
         # in-memory path.
         if cfg.use_two_round_loading and self.predict_fun is None:
             ds = self._load_two_round(filename)
-            if cfg.is_save_binary_file and rank == 0:
+            if cfg.is_save_binary_file and rank == 0 and not cache_incompatible:
                 ds.save_binary(bin_path)  # one writer on shared storage
             return self._apply_rank_partition(ds, rank, num_machines)
 
@@ -304,7 +307,7 @@ class DatasetLoader:
         if self.predict_fun is not None:
             ds.raw_data = feats  # continued training needs raw values
         self._attach_init_score(ds)
-        if cfg.is_save_binary_file and rank == 0:
+        if cfg.is_save_binary_file and rank == 0 and not cache_incompatible:
             ds.save_binary(bin_path)  # one writer on shared storage
         return self._apply_rank_partition(ds, rank, num_machines)
 
@@ -396,6 +399,7 @@ class DatasetLoader:
         label = np.empty(n, dtype=np.float32)
         weights = np.empty(n, dtype=np.float32) if weight_idx >= 0 else None
         qid = np.empty(n, dtype=np.float64) if group_idx >= 0 else None
+        bundle_conflicts = 0
         for start, block in iter_blocks(filename, fmt, cfg.has_header,
                                         num_cols):
             end = start + len(block)
@@ -413,8 +417,13 @@ class DatasetLoader:
                     s = plan.feat_slot[u]
                     off = plan.feat_offset[u]
                     seg = bins[s, start:end]
-                    write = (col > 0) & (seg == 0)
+                    nz = col > 0
+                    bundle_conflicts += int((nz & (seg != 0)).sum())
+                    write = nz & (seg == 0)
                     seg[write] = (col[write] + off).astype(dtype)
+        if bundle_conflicts:
+            Log.warning("Feature bundling: %d conflicting cells kept their "
+                        "first member's bin", bundle_conflicts)
 
         ds = CoreDataset()
         ds.num_total_features = num_feats

@@ -529,13 +529,85 @@ class GBDT:
             return min(self.num_iteration_for_pred * self.num_class, total)
         return total
 
+    def _stacked_model_arrays(self, n_used):
+        """Pad all trees' arrays to one (T, ...) tensor set so prediction
+        traverses EVERY tree at once (the reference parallelizes file
+        prediction across rows with OpenMP, predictor.hpp:82-130; here
+        the tree axis is vectorized too). Cached per model-list state."""
+        key = (n_used, len(self.models))
+        cached = getattr(self, "_stack_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        trees = [self.models[i].materialize()
+                 if hasattr(self.models[i], "materialize") else self.models[i]
+                 for i in range(n_used)]
+        max_l = max(t.num_leaves for t in trees)
+        t_cnt = len(trees)
+        sf = np.zeros((t_cnt, max(max_l - 1, 1)), np.int32)
+        thr = np.zeros_like(sf, dtype=np.float64)
+        dt = np.zeros_like(sf, dtype=np.int8)
+        lc = np.full_like(sf, ~0)
+        rc = np.full_like(sf, ~0)
+        lv = np.zeros((t_cnt, max_l), np.float64)
+        has_split = np.zeros(t_cnt, bool)
+        depth = 1
+        for i, t in enumerate(trees):
+            ns = t.num_leaves - 1
+            if ns > 0:
+                sf[i, :ns] = t.split_feature_real
+                thr[i, :ns] = t.threshold
+                dt[i, :ns] = t.decision_type
+                lc[i, :ns] = t.left_child
+                rc[i, :ns] = t.right_child
+                has_split[i] = True
+                depth = max(depth, t.max_depth)
+            lv[i, :t.num_leaves] = t.leaf_value
+        stacked = (sf, thr, dt, lc, rc, lv, has_split, depth)
+        self._stack_cache = (key, stacked)
+        return stacked
+
     def predict_raw(self, x, num_iteration=-1):
-        """Raw scores for (N, num_total_features) raw values -> (N, K)."""
+        """Raw scores for (N, num_total_features) raw values -> (N, K).
+
+        All trees traverse together: per depth step one (rows, trees)
+        gather instead of a Python loop over trees."""
         x = np.atleast_2d(np.asarray(x, dtype=np.float64))
         n_used = self._num_used_models(num_iteration)
-        out = np.zeros((x.shape[0], self.num_class))
-        for i in range(n_used):
-            out[:, i % self.num_class] += self.models[i].predict(x)
+        n = x.shape[0]
+        out = np.zeros((n, self.num_class))
+        if n_used == 0 or n == 0:
+            return out
+        sf, thr, dt, lc, rc, lv, has_split, depth = \
+            self._stacked_model_arrays(n_used)
+        t_cnt = sf.shape[0]
+        t_idx = np.arange(t_cnt)
+        block = max(1, min(n, 4_000_000 // max(t_cnt, 1)))
+        xs = np.nan_to_num(x)
+        for s in range(0, n, block):
+            xb = x[s:s + block]
+            xbs = xs[s:s + block]
+            node = np.where(has_split[None, :], 0, ~0).astype(np.int32)
+            node = np.broadcast_to(node, (len(xb), t_cnt)).copy()
+            for _ in range(depth):
+                active = node >= 0
+                if not active.any():
+                    break
+                nd = np.maximum(node, 0)
+                feat = sf[t_idx[None, :], nd]
+                th = thr[t_idx[None, :], nd]
+                d = dt[t_idx[None, :], nd]
+                fval = xb[np.arange(len(xb))[:, None], feat]
+                fcat = xbs[np.arange(len(xb))[:, None], feat]
+                go_left = np.where(d == Tree.CATEGORICAL,
+                                   fcat.astype(np.int64) == th.astype(np.int64),
+                                   fval <= th)
+                nxt = np.where(go_left, lc[t_idx[None, :], nd],
+                               rc[t_idx[None, :], nd])
+                node = np.where(active, nxt, node)
+            vals = lv[t_idx[None, :], ~node]                     # (b, T)
+            cls = t_idx % self.num_class   # class-major model list
+            for k in range(self.num_class):
+                out[s:s + block, k] = vals[:, cls == k].sum(axis=1)
         return out
 
     def predict(self, x, num_iteration=-1):

@@ -20,10 +20,14 @@ import numpy as np
 
 
 def _traverse_add(score_row, bins_dev, is_cat, split_feature, threshold_bin,
-                  left_child, right_child, leaf_value, n_splits, scale):
+                  left_child, right_child, leaf_value, n_splits, scale,
+                  feat_slot, feat_off, feat_nb):
     """score_row + scale * leaf_value[leaf(bins)] for one tree, on device.
 
-    bins_dev: (F, N) int bins; tree arrays as produced by
+    bins_dev: (S, N) STORED bins (S == F when unbundled); virtual feature
+    f lives in slot feat_slot[f] at bin offset feat_off[f] with
+    feat_nb[f] bins (identity maps when no bundling — the decode below
+    reduces to the raw bin value). Tree arrays as produced by
     build_tree_device (leaves encoded as ~leaf_index in child arrays).
     A 0-split tree contributes leaf_value[0] == 0, so it is a no-op.
     """
@@ -40,8 +44,11 @@ def _traverse_add(score_row, bins_dev, is_cat, split_feature, threshold_bin,
         i, node = state
         nd = jnp.maximum(node, 0)
         feat = split_feature[nd]
-        fv = jnp.take_along_axis(bins_dev, feat[None, :], axis=0)[0]
-        fv = fv.astype(jnp.int32)
+        sc = jnp.take_along_axis(bins_dev, feat_slot[feat][None, :],
+                                 axis=0)[0].astype(jnp.int32)
+        off = feat_off[feat]
+        nb = feat_nb[feat]
+        fv = jnp.where((sc > off) & (sc <= off + nb - 1), sc - off, 0)
         thr = threshold_bin[nd]
         go_left = jnp.where(is_cat[feat], fv == thr, fv <= thr)
         nxt = jnp.where(go_left, left_child[nd], right_child[nd])
@@ -63,6 +70,7 @@ class ScoreUpdater:
         n = dataset.num_data
         self.num_data = n
         self._is_cat_dev = None
+        self._decode_dev = None
         init = dataset.metadata.init_score
         if init is not None:
             if len(init) != n * self.num_class:
@@ -78,18 +86,36 @@ class ScoreUpdater:
         upd = jnp.take(jnp.asarray(leaf_values, dtype=jnp.float32), row_leaf)
         self.score = self.score.at[curr_class].add(upd)
 
+    def _decode_maps(self):
+        """(feat_slot, feat_off, feat_nb) device arrays: bundle decode
+        when the dataset is bundled, identity maps otherwise."""
+        if self._decode_dev is None:
+            ds = self.dataset
+            nb = np.asarray(ds.num_bin_array(), dtype=np.int32)
+            if ds.bundle_plan is None:
+                slot = np.arange(ds.num_features, dtype=np.int32)
+                off = np.zeros(ds.num_features, dtype=np.int32)
+            else:
+                slot = ds.bundle_plan.feat_slot
+                off = ds.bundle_plan.feat_offset
+            self._decode_dev = (jnp.asarray(slot), jnp.asarray(off),
+                                jnp.asarray(nb))
+        return self._decode_dev
+
     def add_score_by_device_tree(self, out, scale, curr_class):
         """Per-iteration valid-set scoring: device bin-space traversal of
         the builder's raw output dict. No host synchronization."""
         if self._is_cat_dev is None:
             self._is_cat_dev = jnp.asarray(self.dataset.feature_is_categorical())
+        feat_slot, feat_off, feat_nb = self._decode_maps()
         new_row = _traverse_add_jit(
             self.score[curr_class], self.dataset.device_bins(),
             self._is_cat_dev, out["split_feature"],
             out["split_threshold_bin"], out["left_child"],
             out["right_child"],
             jnp.asarray(out["leaf_value"], dtype=jnp.float32),
-            out["n_splits"], jnp.float32(scale))
+            out["n_splits"], jnp.float32(scale),
+            feat_slot, feat_off, feat_nb)
         self.score = self.score.at[curr_class].set(new_row)
 
     def add_score_by_tree(self, tree, curr_class):

@@ -88,6 +88,33 @@ def test_bundled_data_parallel(sparse_data):
         np.testing.assert_array_equal(t1.threshold_in_bin, t2.threshold_in_bin)
 
 
+def test_bundled_train_set_as_valid_set(sparse_data):
+    """Regression: per-iteration device valid scoring must decode bundle
+    slots — a bundled train set registered as its own valid set has to
+    produce valid scores equal to the training scores."""
+    from lightgbm_tpu.metrics import create_metric
+    x, y = sparse_data
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 15, "min_data_in_leaf": 10,
+        "num_iterations": 3, "metric_freq": 0, "is_enable_sparse": True,
+        "device_row_chunk": 512,
+    })
+    ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    assert ds.bundle_plan is not None
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    b = GBDT()
+    b.init(cfg, ds, obj, [])
+    m = create_metric("binary_logloss", cfg)
+    m.init(ds.metadata, ds.num_data)
+    b.add_valid_dataset(ds, [m])
+    for _ in range(3):
+        b.train_one_iter(is_eval=False)
+    train_score = np.asarray(b.train_score_updater.score)
+    valid_score = np.asarray(b.valid_score_updaters[0].score)
+    np.testing.assert_allclose(valid_score, train_score, atol=1e-5)
+
+
 def test_virtual_bins_view_matches_unbundled(sparse_data):
     x, y = sparse_data
     cfg = Config.from_params({"is_enable_sparse": True})
