@@ -217,6 +217,9 @@ class Config:
 
     # TPU-specific knobs (no reference equivalent)
     device_row_chunk: int = 16384  # rows per histogram-matmul chunk
+    # leaf-contiguous builder (models/partitioned.py): "auto" = on for
+    # the serial learner on TPU; "true"/"false" force it
+    partitioned_build: str = "auto"
     profile: str = ""              # jax.profiler trace dir ("1" = default dir)
 
     @classmethod

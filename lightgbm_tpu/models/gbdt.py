@@ -582,10 +582,9 @@ class GBDT:
         t_cnt = sf.shape[0]
         t_idx = np.arange(t_cnt)
         block = max(1, min(n, 4_000_000 // max(t_cnt, 1)))
-        xs = np.nan_to_num(x)
         for s in range(0, n, block):
             xb = x[s:s + block]
-            xbs = xs[s:s + block]
+            xbs = np.nan_to_num(xb)  # per block: keeps peak memory O(block)
             node = np.where(has_split[None, :], 0, ~0).astype(np.int32)
             node = np.broadcast_to(node, (len(xb), t_cnt)).copy()
             for _ in range(depth):

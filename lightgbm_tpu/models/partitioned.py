@@ -1,0 +1,184 @@
+"""Partitioned (leaf-contiguous) tree builder: histogram cost scales
+with leaf size, not dataset size.
+
+Reference: the combination of DataPartition (data_partition.hpp:17-201,
+contiguous per-leaf row indices), OrderedSparseBin's leaf-grouped
+re-partitioning (ordered_sparse_bin.hpp:25-133) and the ordered-
+gradient gathers of SerialTreeLearner::BeforeFindBestSplit
+(serial_tree_learner.cpp:236-337) — the reference's machinery for
+making per-leaf histogram cost proportional to rows-in-leaf.
+
+The masked builder (tree_learner.py build_tree_device) streams ALL N
+rows for every split: exact but O(N) per split — at 63 leaves ~96% of
+that streaming is rows of other leaves (BASELINE.md "Known bound").
+This builder keeps the bin matrix PHYSICALLY sorted by leaf:
+
+- rows live in packed words (4 features/int32, ops/ordered_hist.py);
+  a leaf is a position range [seg_begin[leaf], +seg_cnt[leaf]);
+- a split stable-partitions the segment with one vectorized prefix-sum
+  pass + one scatter + gathers (ops/partition.py) — the TPU analog of
+  DataPartition::Split's per-thread buffers + prefix-sum copy-back;
+- the smaller child's histogram streams only the chunks covering its
+  segment (power-of-two bucketed `lax.switch`, ops/ordered_hist.py);
+  the larger child is parent - smaller, as everywhere else.
+
+Semantics (split scans, gain formulas, tie-breaks, depth guard,
+subtraction trick, leaf-wise best-leaf order) are identical to the
+masked builder; only the row-summation ORDER inside a histogram
+differs, so f32 round-off can differ in the last ulps. The serial
+masked builder remains the reference point for the exact
+serial == parallel equality tests (tests/test_parallel.py).
+
+Everything runs inside one `lax.fori_loop` — no host round-trips — so
+the fused multi-iteration trainer (models/gbdt.py train_many) embeds
+this builder exactly like the masked one.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.ordered_hist import segment_histograms, unpack_feature
+from ..ops.partition import (apply_partition, invert_permutation,
+                             split_destinations)
+from ..ops.split import SplitParams, find_best_split, K_MIN_SCORE
+from .tree_learner import apply_tree_split, init_split_state, write_candidate
+
+
+def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
+                           num_bin_pf, is_cat,
+                           *, num_leaves, max_bin, params: SplitParams,
+                           max_depth, f_real):
+    """Grow one leaf-wise tree on device over the packed-word layout.
+
+    Args:
+      words: (W, N_pad) int32 packed bins, N_pad % HIST_CHUNK == 0.
+      grad, hess, inbag: (N_pad,) float32 (pad rows: inbag == 0).
+      feature_mask: (F_pad,) bool; num_bin_pf: (F_pad,) int32;
+      is_cat: (F_pad,) bool, F_pad == 4 * W.
+      num_leaves, max_bin, params, max_depth, f_real: static config.
+
+    Returns the same output dict as build_tree_device (tree arrays +
+    original-order row->leaf partition).
+    """
+    w, n_pad = words.shape
+    l = num_leaves
+    b = max_bin
+    f32 = jnp.float32
+    f_pad = 4 * w
+    assert f_real <= f_pad
+
+    def scan_leaf(hist3, sum_g, sum_h, cnt):
+        return find_best_split(hist3, sum_g, sum_h, cnt,
+                               num_bin_pf, is_cat, feature_mask, params)
+
+    g_in = grad * inbag
+    h_in = hess * inbag
+    ghc0 = jnp.stack([g_in, h_in, inbag], axis=0)  # (3, N_pad)
+
+    def leaf_histogram(words_c, ghc_c, begin, cnt):
+        return segment_histograms(words_c, ghc_c, begin, cnt, b, f_pad)
+
+    # ---- root ----------------------------------------------------------
+    hist_root = leaf_histogram(words, ghc0, jnp.int32(0), jnp.int32(n_pad))
+    # root sums from the histogram: feature 0's bins partition the rows
+    root_g = jnp.sum(hist_root[0, :, 0])
+    root_h = jnp.sum(hist_root[0, :, 1])
+    root_c = jnp.sum(hist_root[0, :, 2])
+    root_split = scan_leaf(hist_root, root_g, root_h, root_c)
+
+    state = init_split_state(l, root_split, root_c)
+    state["words"] = words
+    state["ghc"] = ghc0
+    state["perm"] = jnp.arange(n_pad, dtype=jnp.int32)  # position -> orig row
+    state["pos_leaf"] = jnp.zeros(n_pad, dtype=jnp.int32)
+    state["seg_begin"] = jnp.zeros(l, dtype=jnp.int32)
+    # FULL row counts (in-bag + oob + pad), not the tree's in-bag counts
+    state["seg_cnt"] = jnp.zeros(l, dtype=jnp.int32).at[0].set(n_pad)
+    state["hist_cache"] = (jnp.zeros((l, f_pad, b, 3), dtype=f32)
+                           .at[0].set(hist_root))
+
+    def body(i, st):
+        best_leaf = jnp.argmax(st["best_gain"]).astype(jnp.int32)
+        gain = st["best_gain"][best_leaf]
+        do = jnp.logical_and(jnp.logical_not(st["done"]), gain > 0.0)
+
+        def no_split(st):
+            st = dict(st)
+            st["done"] = jnp.asarray(True)
+            return st
+
+        def do_split(st):
+            st = dict(st)
+            st, node, right_id, feat, thr = apply_tree_split(
+                st, i, best_leaf, gain, l)
+
+            # ---- physical re-partition (DataPartition::Split)
+            seg_b = st["seg_begin"][best_leaf]
+            seg_c = st["seg_cnt"][best_leaf]
+            col = unpack_feature(st["words"], feat)
+            go_left = jnp.where(is_cat[feat], col == thr, col <= thr)
+            dest, n_left = split_destinations(go_left, seg_b, seg_c)
+            src = invert_permutation(dest)
+            st["words"], st["ghc"], st["perm"] = apply_partition(
+                src, st["words"], st["ghc"], st["perm"])
+            st["seg_begin"] = st["seg_begin"].at[right_id].set(seg_b + n_left)
+            st["seg_cnt"] = (st["seg_cnt"].at[best_leaf].set(n_left)
+                             .at[right_id].set(seg_c - n_left))
+            pos = jnp.arange(n_pad, dtype=jnp.int32)
+            st["pos_leaf"] = jnp.where(
+                (pos >= seg_b + n_left) & (pos < seg_b + seg_c),
+                right_id, st["pos_leaf"])
+
+            # ---- smaller-child histogram + parent subtraction
+            # smaller side by GLOBAL in-bag count, matching the masked
+            # builder (data_parallel_tree_learner.cpp:178-187)
+            left_is_small = st["best_lc"][best_leaf] <= st["best_rc"][best_leaf]
+            small_b = jnp.where(left_is_small, seg_b, seg_b + n_left)
+            small_c = jnp.where(left_is_small, n_left, seg_c - n_left)
+            hist_small = leaf_histogram(st["words"], st["ghc"],
+                                        small_b, small_c)
+            hist_large = st["hist_cache"][best_leaf] - hist_small
+            hist_left = jnp.where(left_is_small, hist_small, hist_large)
+            hist_right = jnp.where(left_is_small, hist_large, hist_small)
+            st["hist_cache"] = (st["hist_cache"].at[best_leaf].set(hist_left)
+                                .at[right_id].set(hist_right))
+
+            # ---- children leaf state (LeafSplits::Init after split)
+            child_depth = st["leaf_depth"][best_leaf] + 1
+            st["leaf_depth"] = (st["leaf_depth"].at[best_leaf].set(child_depth)
+                                .at[right_id].set(child_depth))
+
+            lsplit = scan_leaf(hist_left, st["best_lg"][best_leaf],
+                               st["best_lh"][best_leaf], st["best_lc"][best_leaf])
+            rsplit = scan_leaf(hist_right, st["best_rg"][best_leaf],
+                               st["best_rh"][best_leaf], st["best_rc"][best_leaf])
+
+            # max_depth guard (serial_tree_learner.cpp:238-247)
+            depth_ok = jnp.logical_or(max_depth < 0, child_depth < max_depth)
+            lgain = jnp.where(depth_ok, lsplit.gain, K_MIN_SCORE)
+            rgain = jnp.where(depth_ok, rsplit.gain, K_MIN_SCORE)
+
+            st = write_candidate(st, best_leaf, lsplit, lgain)
+            st = write_candidate(st, right_id, rsplit, rgain)
+            return st
+
+        return jax.lax.cond(do, do_split, no_split, st)
+
+    state = jax.lax.fori_loop(0, l - 1, body, state)
+    # original-order row->leaf map: one scatter at tree end
+    row_leaf = (jnp.zeros(n_pad, dtype=jnp.int32)
+                .at[state["perm"]].set(state["pos_leaf"]))
+    return {
+        "n_splits": state["n_splits"],
+        "row_leaf": row_leaf,
+        "split_feature": state["split_feature"],
+        "split_threshold_bin": state["split_threshold_bin"],
+        "split_gain": state["split_gain"],
+        "left_child": state["left_child"],
+        "right_child": state["right_child"],
+        "leaf_parent": state["leaf_parent"],
+        "leaf_value": state["leaf_value"],
+        "leaf_count": state["leaf_count"],
+        "internal_value": state["internal_value"],
+        "internal_count": state["internal_count"],
+    }

@@ -51,6 +51,97 @@ def _identity(x):
     return x
 
 
+def init_split_state(l, root_split, root_c):
+    """Per-leaf candidate + tree arrays shared by both builders
+    (masked build_tree_device and models/partitioned.py)."""
+    f32 = jnp.float32
+
+    def set0(arr, v):
+        return arr.at[0].set(v)
+
+    return {
+        "done": jnp.asarray(False),
+        "n_splits": jnp.asarray(0, dtype=jnp.int32),
+        # per-leaf split candidates (LeafSplits + best_split_per_leaf_)
+        "best_gain": jnp.full(l, K_MIN_SCORE, dtype=f32).at[0].set(root_split.gain),
+        "best_feature": set0(jnp.zeros(l, jnp.int32), root_split.feature),
+        "best_threshold": set0(jnp.zeros(l, jnp.int32), root_split.threshold),
+        "best_lg": set0(jnp.zeros(l, f32), root_split.left_sum_gradient),
+        "best_lh": set0(jnp.zeros(l, f32), root_split.left_sum_hessian),
+        "best_lc": set0(jnp.zeros(l, f32), root_split.left_count),
+        "best_rg": set0(jnp.zeros(l, f32), root_split.right_sum_gradient),
+        "best_rh": set0(jnp.zeros(l, f32), root_split.right_sum_hessian),
+        "best_rc": set0(jnp.zeros(l, f32), root_split.right_count),
+        "best_lout": set0(jnp.zeros(l, f32), root_split.left_output),
+        "best_rout": set0(jnp.zeros(l, f32), root_split.right_output),
+        "leaf_depth": jnp.zeros(l, dtype=jnp.int32),
+        # tree arrays (models/tree.py)
+        "split_feature": jnp.zeros(l - 1, dtype=jnp.int32),
+        "split_threshold_bin": jnp.zeros(l - 1, dtype=jnp.int32),
+        "split_gain": jnp.zeros(l - 1, dtype=f32),
+        "left_child": jnp.zeros(l - 1, dtype=jnp.int32),
+        "right_child": jnp.zeros(l - 1, dtype=jnp.int32),
+        "leaf_parent": jnp.full(l, -1, dtype=jnp.int32),
+        "leaf_value": jnp.zeros(l, dtype=f32),
+        "leaf_count": jnp.zeros(l, dtype=jnp.int32).at[0].set(root_c.astype(jnp.int32)),
+        "internal_value": jnp.zeros(l - 1, dtype=f32),
+        "internal_count": jnp.zeros(l - 1, dtype=jnp.int32),
+    }
+
+
+def apply_tree_split(st, i, best_leaf, gain, l):
+    """Tree bookkeeping for splitting `best_leaf` at iteration i
+    (Tree::Split, tree.cpp:51-97). Returns (st, node, right_id)."""
+    node = i  # splits happen on consecutive iterations
+    right_id = i + 1  # new leaf id == num_leaves so far (tree.cpp:55)
+    feat = st["best_feature"][best_leaf]
+    thr = st["best_threshold"][best_leaf]
+
+    parent = st["leaf_parent"][best_leaf]
+    was_left = st["left_child"][jnp.maximum(parent, 0)] == ~best_leaf
+    lc = st["left_child"]
+    rc = st["right_child"]
+    lc = jnp.where(
+        (jnp.arange(l - 1) == parent) & (parent >= 0) & was_left, node, lc)
+    rc = jnp.where(
+        (jnp.arange(l - 1) == parent) & (parent >= 0) & ~was_left, node, rc)
+    st["left_child"] = lc.at[node].set(~best_leaf)
+    st["right_child"] = rc.at[node].set(~right_id)
+    st["split_feature"] = st["split_feature"].at[node].set(feat)
+    st["split_threshold_bin"] = st["split_threshold_bin"].at[node].set(thr)
+    st["split_gain"] = st["split_gain"].at[node].set(gain)
+    st["leaf_parent"] = (st["leaf_parent"].at[best_leaf].set(node)
+                         .at[right_id].set(node))
+    st["internal_value"] = st["internal_value"].at[node].set(
+        st["leaf_value"][best_leaf])
+    st["internal_count"] = st["internal_count"].at[node].set(
+        (st["best_lc"][best_leaf] + st["best_rc"][best_leaf]).astype(jnp.int32))
+    st["leaf_value"] = (st["leaf_value"]
+                        .at[best_leaf].set(st["best_lout"][best_leaf])
+                        .at[right_id].set(st["best_rout"][best_leaf]))
+    st["leaf_count"] = (st["leaf_count"]
+                        .at[best_leaf].set(st["best_lc"][best_leaf].astype(jnp.int32))
+                        .at[right_id].set(st["best_rc"][best_leaf].astype(jnp.int32)))
+    st["n_splits"] = st["n_splits"] + 1
+    return st, node, right_id, feat, thr
+
+
+def write_candidate(st, leaf_id, sp, gain_v):
+    """Store a leaf's best-split candidate in the per-leaf state."""
+    st["best_gain"] = st["best_gain"].at[leaf_id].set(gain_v)
+    st["best_feature"] = st["best_feature"].at[leaf_id].set(sp.feature)
+    st["best_threshold"] = st["best_threshold"].at[leaf_id].set(sp.threshold)
+    st["best_lg"] = st["best_lg"].at[leaf_id].set(sp.left_sum_gradient)
+    st["best_lh"] = st["best_lh"].at[leaf_id].set(sp.left_sum_hessian)
+    st["best_lc"] = st["best_lc"].at[leaf_id].set(sp.left_count)
+    st["best_rg"] = st["best_rg"].at[leaf_id].set(sp.right_sum_gradient)
+    st["best_rh"] = st["best_rh"].at[leaf_id].set(sp.right_sum_hessian)
+    st["best_rc"] = st["best_rc"].at[leaf_id].set(sp.right_count)
+    st["best_lout"] = st["best_lout"].at[leaf_id].set(sp.left_output)
+    st["best_rout"] = st["best_rout"].at[leaf_id].set(sp.right_output)
+    return st
+
+
 def _collapse_pair(pair):
     """Default hist reduction hook: no shards, just collapse the
     compensated (value, residual) pair."""
@@ -142,40 +233,10 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
     root_c = sum_psum_fn(jnp.sum(hist_root[0, :, 2]))
     root_split = scan_leaf(hist_root, root_g, root_h, root_c)
 
-    def set0(arr, v):
-        return arr.at[0].set(v)
-
-    state = {
-        "row_leaf": row_leaf0,
-        # per-leaf histogram cache (HistogramPool, fixed buffer)
-        "hist_cache": jnp.zeros((l, f, b, 3), dtype=f32).at[0].set(hist_root),
-        "done": jnp.asarray(False),
-        "n_splits": jnp.asarray(0, dtype=jnp.int32),
-        # per-leaf split candidates (LeafSplits + best_split_per_leaf_)
-        "best_gain": jnp.full(l, K_MIN_SCORE, dtype=f32).at[0].set(root_split.gain),
-        "best_feature": set0(jnp.zeros(l, jnp.int32), root_split.feature),
-        "best_threshold": set0(jnp.zeros(l, jnp.int32), root_split.threshold),
-        "best_lg": set0(jnp.zeros(l, f32), root_split.left_sum_gradient),
-        "best_lh": set0(jnp.zeros(l, f32), root_split.left_sum_hessian),
-        "best_lc": set0(jnp.zeros(l, f32), root_split.left_count),
-        "best_rg": set0(jnp.zeros(l, f32), root_split.right_sum_gradient),
-        "best_rh": set0(jnp.zeros(l, f32), root_split.right_sum_hessian),
-        "best_rc": set0(jnp.zeros(l, f32), root_split.right_count),
-        "best_lout": set0(jnp.zeros(l, f32), root_split.left_output),
-        "best_rout": set0(jnp.zeros(l, f32), root_split.right_output),
-        "leaf_depth": jnp.zeros(l, dtype=jnp.int32),
-        # tree arrays (models/tree.py)
-        "split_feature": jnp.zeros(l - 1, dtype=jnp.int32),
-        "split_threshold_bin": jnp.zeros(l - 1, dtype=jnp.int32),
-        "split_gain": jnp.zeros(l - 1, dtype=f32),
-        "left_child": jnp.zeros(l - 1, dtype=jnp.int32),
-        "right_child": jnp.zeros(l - 1, dtype=jnp.int32),
-        "leaf_parent": jnp.full(l, -1, dtype=jnp.int32),
-        "leaf_value": jnp.zeros(l, dtype=f32),
-        "leaf_count": jnp.zeros(l, dtype=jnp.int32).at[0].set(root_c.astype(jnp.int32)),
-        "internal_value": jnp.zeros(l - 1, dtype=f32),
-        "internal_count": jnp.zeros(l - 1, dtype=jnp.int32),
-    }
+    state = init_split_state(l, root_split, root_c)
+    state["row_leaf"] = row_leaf0
+    # per-leaf histogram cache (HistogramPool, fixed buffer)
+    state["hist_cache"] = jnp.zeros((l, f, b, 3), dtype=f32).at[0].set(hist_root)
 
     def body(i, st):
         best_leaf = jnp.argmax(st["best_gain"]).astype(jnp.int32)
@@ -189,38 +250,8 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
 
         def do_split(st):
             st = dict(st)
-            node = i  # splits happen on consecutive iterations
-            right_id = i + 1  # new leaf id == num_leaves so far (tree.cpp:55)
-            feat = st["best_feature"][best_leaf]
-            thr = st["best_threshold"][best_leaf]
-
-            # ---- tree bookkeeping (Tree::Split, tree.cpp:51-97)
-            parent = st["leaf_parent"][best_leaf]
-            was_left = st["left_child"][jnp.maximum(parent, 0)] == ~best_leaf
-            lc = st["left_child"]
-            rc = st["right_child"]
-            lc = jnp.where(
-                (jnp.arange(l - 1) == parent) & (parent >= 0) & was_left, node, lc)
-            rc = jnp.where(
-                (jnp.arange(l - 1) == parent) & (parent >= 0) & ~was_left, node, rc)
-            st["left_child"] = lc.at[node].set(~best_leaf)
-            st["right_child"] = rc.at[node].set(~right_id)
-            st["split_feature"] = st["split_feature"].at[node].set(feat)
-            st["split_threshold_bin"] = st["split_threshold_bin"].at[node].set(thr)
-            st["split_gain"] = st["split_gain"].at[node].set(gain)
-            st["leaf_parent"] = (st["leaf_parent"].at[best_leaf].set(node)
-                                 .at[right_id].set(node))
-            st["internal_value"] = st["internal_value"].at[node].set(
-                st["leaf_value"][best_leaf])
-            st["internal_count"] = st["internal_count"].at[node].set(
-                (st["best_lc"][best_leaf] + st["best_rc"][best_leaf]).astype(jnp.int32))
-            st["leaf_value"] = (st["leaf_value"]
-                                .at[best_leaf].set(st["best_lout"][best_leaf])
-                                .at[right_id].set(st["best_rout"][best_leaf]))
-            st["leaf_count"] = (st["leaf_count"]
-                                .at[best_leaf].set(st["best_lc"][best_leaf].astype(jnp.int32))
-                                .at[right_id].set(st["best_rc"][best_leaf].astype(jnp.int32)))
-            st["n_splits"] = st["n_splits"] + 1
+            st, node, right_id, feat, thr = apply_tree_split(
+                st, i, best_leaf, gain, l)
 
             # ---- partition update (DataPartition::Split): one where()
             col = split_col_fn(feat)
@@ -257,22 +288,8 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
             lgain = jnp.where(depth_ok, lsplit.gain, K_MIN_SCORE)
             rgain = jnp.where(depth_ok, rsplit.gain, K_MIN_SCORE)
 
-            def write(st, leaf_id, sp, gain_v):
-                st["best_gain"] = st["best_gain"].at[leaf_id].set(gain_v)
-                st["best_feature"] = st["best_feature"].at[leaf_id].set(sp.feature)
-                st["best_threshold"] = st["best_threshold"].at[leaf_id].set(sp.threshold)
-                st["best_lg"] = st["best_lg"].at[leaf_id].set(sp.left_sum_gradient)
-                st["best_lh"] = st["best_lh"].at[leaf_id].set(sp.left_sum_hessian)
-                st["best_lc"] = st["best_lc"].at[leaf_id].set(sp.left_count)
-                st["best_rg"] = st["best_rg"].at[leaf_id].set(sp.right_sum_gradient)
-                st["best_rh"] = st["best_rh"].at[leaf_id].set(sp.right_sum_hessian)
-                st["best_rc"] = st["best_rc"].at[leaf_id].set(sp.right_count)
-                st["best_lout"] = st["best_lout"].at[leaf_id].set(sp.left_output)
-                st["best_rout"] = st["best_rout"].at[leaf_id].set(sp.right_output)
-                return st
-
-            st = write(st, best_leaf, lsplit, lgain)
-            st = write(st, right_id, rsplit, rgain)
+            st = write_candidate(st, best_leaf, lsplit, lgain)
+            st = write_candidate(st, right_id, rsplit, rgain)
             return st
 
         return jax.lax.cond(do, do_split, no_split, st)
@@ -313,6 +330,7 @@ class SerialTreeLearner:
         # several features' bin ranges; io/bundling.py)
         self.max_bin = int(train_set.max_stored_bin)
         self._bundle = train_set.bundle_plan
+        self._use_partitioned = self._partitioned_enabled(cfg)
         if self._bundle is not None:
             from ..io.bundling import expansion_maps
             src, slot_of = expansion_maps(self._bundle, train_set.bin_mappers,
@@ -367,24 +385,54 @@ class SerialTreeLearner:
         Log.info("Number of data: %d, number of features: %d",
                  self.num_data, self.num_features)
 
+    def _partitioned_enabled(self, cfg):
+        """Leaf-contiguous builder (models/partitioned.py): serial
+        learner only; "auto" turns it on for TPU backends. Multiclass
+        keeps the masked builder (its fused path vmaps the builder over
+        classes, and vmap of the bucketed `lax.switch` would execute
+        every bucket branch)."""
+        if type(self) is not SerialTreeLearner:
+            return False
+        mode = str(getattr(cfg, "partitioned_build", "auto")).lower()
+        if mode in ("false", "0", "off", "-"):
+            return False
+        if mode not in ("true", "1", "on", "+", "auto"):
+            Log.fatal('partitioned_build must be "auto", "true" or '
+                      '"false", got [%s]', mode)
+        eligible = (self._bundle is None
+                    and int(self.train_set.max_stored_bin) <= 256
+                    and int(getattr(cfg, "num_class", 1)) == 1)
+        if mode in ("true", "1", "on", "+"):
+            if not eligible:
+                Log.warning("partitioned_build=true ignored: needs an "
+                            "unbundled dataset, max_bin <= 256, num_class=1")
+            return eligible
+        return eligible and jax.default_backend() == "tpu"
+
     # hooks overridden by the parallel learners (parallel/learners.py) -------
     def _pad_rows(self, n, chunk):
-        if jax.default_backend() == "tpu":
-            # the pallas histogram kernel grids over fixed HIST_CHUNK blocks
+        if jax.default_backend() == "tpu" or self._use_partitioned:
+            # the pallas/segment histogram kernels grid over fixed
+            # HIST_CHUNK blocks
             return ((n + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
         return ((n + chunk - 1) // chunk) * chunk if n > chunk else n
 
     def _effective_chunk(self, chunk):
-        if jax.default_backend() == "tpu":
+        if jax.default_backend() == "tpu" or self._use_partitioned:
             # rows are padded to HIST_CHUNK multiples; the XLA-fallback
             # scan chunk must divide that
             return min(chunk, HIST_CHUNK)
         return min(chunk, self.n_pad)
 
     def _pad_feature_count(self, f):
+        if self._use_partitioned:
+            return ((f + 3) // 4) * 4  # packed words hold 4 features
         return f
 
     def _place_bins(self, bins):
+        if self._use_partitioned:
+            from ..ops.ordered_hist import pack_feature_words
+            return jnp.asarray(pack_feature_words(bins))
         return jnp.asarray(bins)
 
     def _place_rows(self, arr):
@@ -435,6 +483,16 @@ class SerialTreeLearner:
         """The un-jitted builder closure — also consumed directly by the
         fused multi-iteration trainer (models/gbdt.py train_many), which
         embeds it inside its own scanned program."""
+        if self._use_partitioned:
+            from .partitioned import build_tree_partitioned
+            return functools.partial(
+                build_tree_partitioned,
+                num_leaves=int(cfg.num_leaves),
+                max_bin=self.max_bin,
+                params=self.params,
+                max_depth=int(cfg.max_depth),
+                f_real=self.num_features,
+            )
         base = functools.partial(
             build_tree_device,
             num_leaves=int(cfg.num_leaves),

@@ -1,0 +1,170 @@
+"""Segment histograms over a leaf-contiguous row layout — the hot op of
+the partitioned tree builder (models/partitioned.py).
+
+Reference semantics: ordered_sparse_bin.hpp:25-133 / data_partition.hpp
+keep per-leaf row indices contiguous so per-leaf histogram cost is
+proportional to leaf size. The TPU translation: rows are kept
+PHYSICALLY sorted by leaf (ops/partition.py), a leaf is a position
+range [begin, begin+cnt), and its histogram streams only the chunks
+covering that range — sequential HBM reads, no gathers, cost
+O(leaf_rows) instead of the masked builder's O(N) per split
+(ops/pallas_hist.py BASELINE.md bound).
+
+Static shapes under jit come from BUCKETING: segment lengths are
+rounded up to a power-of-two number of HIST_CHUNK-row chunks and
+`lax.switch` dispatches to the matching pre-compiled variant; boundary
+chunks mask rows outside the range by position (two iota compares —
+there is no row_leaf array at all on this path).
+
+Bins are packed 4 features per int32 word (W = ceil(F/4), feature f in
+byte f%4 of word f//4): one permutation gather moves 4 features at
+once, and the kernel unpacks with a shift+mask (2 VPU ops per feature
+per chunk, far below the B x C one-hot compares).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_hist import HIST_CHUNK
+
+
+def pack_feature_words(bins_u8):
+    """(F, N) uint8 bins -> (ceil(F/4), N) int32 packed words (host)."""
+    f, n = bins_u8.shape
+    w = (f + 3) // 4
+    padded = np.zeros((w * 4, n), dtype=np.uint8)
+    padded[:f] = bins_u8
+    p = padded.reshape(w, 4, n).astype(np.uint32)
+    words = p[:, 0] | (p[:, 1] << 8) | (p[:, 2] << 16) | (p[:, 3] << 24)
+    return words.view(np.int32)
+
+
+def unpack_feature(words, feat):
+    """Bin column of (traced) feature id `feat` from packed words."""
+    word = jnp.take(words, feat >> 2, axis=0)
+    return (word >> ((feat & 3) * 8)) & 0xFF
+
+
+def _bucket_sizes(n_chunks):
+    """Power-of-two chunk buckets up to the full array."""
+    sizes = []
+    b = 1
+    while b < n_chunks:
+        sizes.append(b)
+        b *= 2
+    sizes.append(n_chunks)
+    return sizes
+
+
+def _seg_hist_kernel(lohi_ref, words_ref, ghc_ref, out_ref, *, f, b_pad):
+    """One grid step = one HIST_CHUNK block of the sliced segment."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    c = words_ref.shape[1]
+    pos = step * c + jax.lax.broadcasted_iota(jnp.int32, (c,), 0)
+    mask = ((pos >= lohi_ref[0]) & (pos < lohi_ref[1])).astype(jnp.float32)
+    ghc_m = ghc_ref[...] * mask[:, None]                          # (C, 3)
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (b_pad, c), 0)
+    for i in range(f):
+        word = words_ref[i >> 2, :]
+        bins_f = (word >> ((i & 3) * 8)) & 0xFF
+        onehot = (bins_f[None, :] == b_iota).astype(jnp.float32)  # (B_pad, C)
+        out_ref[i, :, :] += jax.lax.dot_general(
+            onehot, ghc_m, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                   # (B_pad, 3)
+
+
+def _seg_hist_tpu(words_sl, ghc_sl, lo, hi, f, num_bins_total, n_blocks):
+    """Pallas segment histogram over a chunk-aligned slice."""
+    w = words_sl.shape[0]
+    b_pad = max(((num_bins_total + 127) // 128) * 128, 128)
+    kernel = functools.partial(_seg_hist_kernel, f=f, b_pad=b_pad)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # (2,) lo/hi
+            pl.BlockSpec((w, HIST_CHUNK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((HIST_CHUNK, 3), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((f, b_pad, 3), lambda i: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((f, b_pad, 3), jnp.float32),
+    )(jnp.stack([lo, hi]).astype(jnp.int32), words_sl, ghc_sl)
+    return out[:, :num_bins_total, :]
+
+
+def _seg_hist_xla(words_sl, ghc_sl, lo, hi, f, num_bins_total):
+    """XLA fallback (CPU tests / non-TPU): unpack + positional mask +
+    the chunked one-hot einsum of ops/histogram.py."""
+    from .histogram import build_histograms
+    w, n = words_sl.shape
+    shifts = jnp.arange(4, dtype=jnp.int32) * 8
+    bins = ((words_sl[:, None, :] >> shifts[None, :, None]) & 0xFF)
+    bins = bins.reshape(w * 4, n)[:f]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    mask = ((pos >= lo) & (pos < hi)).astype(jnp.float32)
+    ghc_m = ghc_sl * mask[:, None]
+    return build_histograms(bins, ghc_m, num_bins_total,
+                            row_chunk=min(n, HIST_CHUNK))
+
+
+def segment_histograms(words, ghc_t, begin, cnt, num_bins_total, f,
+                       interpret_backend=None):
+    """hist[f, b, k] over the position range [begin, begin+cnt).
+
+    Args:
+      words: (W, N) int32 packed bins (leaf-ordered), N % HIST_CHUNK == 0.
+      ghc_t: (3, N) float32 leaf-ordered stats (grad*inbag, hess*inbag,
+        inbag); padding rows must be zero.
+      begin, cnt: traced int32 segment bounds.
+      num_bins_total: static histogram width B.
+      f: static real feature count (<= 4W).
+
+    Returns (F, B, 3) float32. Cost scales with the power-of-two chunk
+    bucket covering the segment, not with N.
+    """
+    w, n = words.shape
+    if n % HIST_CHUNK != 0:
+        raise ValueError(f"N={n} must be a multiple of {HIST_CHUNK}")
+    n_chunks = n // HIST_CHUNK
+    buckets = _bucket_sizes(n_chunks)
+
+    begin = begin.astype(jnp.int32)
+    cnt = jnp.maximum(cnt, 0).astype(jnp.int32)
+    c_first = begin // HIST_CHUNK
+    c_last = (begin + jnp.maximum(cnt, 1) - 1) // HIST_CHUNK
+    needed = c_last - c_first + 1
+    idx = jnp.searchsorted(jnp.asarray(buckets, dtype=jnp.int32), needed)
+
+    on_tpu = (jax.default_backend() == "tpu"
+              if interpret_backend is None else interpret_backend == "tpu")
+
+    def make_branch(bk):
+        def branch(begin, cnt):
+            c0 = jnp.clip(c_first, 0, n_chunks - bk)
+            start = c0 * HIST_CHUNK
+            words_sl = jax.lax.dynamic_slice(
+                words, (jnp.int32(0), start), (w, bk * HIST_CHUNK))
+            ghc_sl = jax.lax.dynamic_slice(
+                ghc_t, (jnp.int32(0), start), (3, bk * HIST_CHUNK)).T
+            lo = begin - start
+            hi = lo + cnt
+            if on_tpu:
+                return _seg_hist_tpu(words_sl, ghc_sl, lo, hi, f,
+                                     num_bins_total, bk)
+            return _seg_hist_xla(words_sl, ghc_sl, lo, hi, f, num_bins_total)
+        return branch
+
+    return jax.lax.switch(idx, [make_branch(b) for b in buckets], begin, cnt)

@@ -1,0 +1,55 @@
+"""Device stable partition of a leaf-contiguous row layout.
+
+Reference: DataPartition::Split (data_partition.hpp:100-140) — per-
+thread left/right buffers merged by prefix sum keep each leaf's row
+indices contiguous and in stable order. The TPU translation is the
+same prefix-sum idea without threads: one vectorized pass computes
+every row's destination position, and the permutation is applied as a
+single scatter + gathers.
+
+All rows of the split segment move — including out-of-bag and padding
+rows (their statistics are zero, so placement is free of side effects);
+the counts used by the tree remain the in-bag histogram counts.
+"""
+
+import jax.numpy as jnp
+
+
+def split_destinations(go_left, begin, cnt):
+    """Stable-partition destinations for the segment [begin, begin+cnt).
+
+    Args:
+      go_left: (N,) bool in CURRENT position order (only the segment's
+        values matter).
+      begin, cnt: traced int32 segment bounds.
+
+    Returns (dest, n_left): dest (N,) int32 maps position p -> new
+    position (identity outside the segment); n_left is the FULL left
+    row count (in-bag + out-of-bag + padding).
+    """
+    n = go_left.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    in_seg = (pos >= begin) & (pos < begin + cnt)
+    lm = in_seg & go_left
+    rm = in_seg & ~go_left
+    rank_l = jnp.cumsum(lm.astype(jnp.int32)) - 1  # 0-based within lm
+    rank_r = jnp.cumsum(rm.astype(jnp.int32)) - 1
+    n_left = rank_l[-1] + 1
+    dest = jnp.where(
+        lm, begin + rank_l,
+        jnp.where(rm, begin + n_left + rank_r, pos)).astype(jnp.int32)
+    return dest, n_left
+
+
+def invert_permutation(dest):
+    """src such that new[q] = old[src[q]] given new[dest[p]] = old[p]."""
+    n = dest.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    return jnp.zeros(n, jnp.int32).at[dest].set(pos)
+
+
+def apply_partition(src, words, ghc_t, perm):
+    """Permute the leaf-ordered arrays by the inverse permutation."""
+    return (jnp.take(words, src, axis=1),
+            jnp.take(ghc_t, src, axis=1),
+            jnp.take(perm, src))

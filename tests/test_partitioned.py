@@ -1,0 +1,136 @@
+"""Partitioned (leaf-contiguous) builder: packing, segment histograms,
+stable partition, and tree/functional parity with the masked builder.
+
+The masked builder (models/tree_learner.py) is the semantic reference;
+models/partitioned.py must grow the same trees up to f32 summation-
+order ulps (SURVEY.md hard-part #2 semantics: tie-breaks, gain <= 0
+stop, depth guard)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import DatasetLoader
+from lightgbm_tpu.metrics import create_metric
+from lightgbm_tpu.models.gbdt import GBDT
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.ops.histogram import build_histograms
+from lightgbm_tpu.ops.ordered_hist import (pack_feature_words,
+                                           segment_histograms,
+                                           unpack_feature)
+from lightgbm_tpu.ops.partition import (apply_partition,
+                                        invert_permutation,
+                                        split_destinations)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.RandomState(0)
+    bins = rng.randint(0, 256, size=(10, 64), dtype=np.uint8)
+    words = pack_feature_words(bins)
+    assert words.shape == (3, 64) and words.dtype == np.int32
+    for f in range(10):
+        got = np.asarray(unpack_feature(jnp.asarray(words), jnp.int32(f)))
+        np.testing.assert_array_equal(got, bins[f].astype(np.int32))
+
+
+def test_segment_histogram_matches_dense():
+    rng = np.random.RandomState(1)
+    n, f, b = 8192, 6, 16
+    bins = rng.randint(0, b, size=(f, n), dtype=np.uint8)
+    words = jnp.asarray(pack_feature_words(bins))
+    ghc = rng.rand(3, n).astype(np.float32)
+    for begin, cnt in [(0, n), (100, 500), (4000, 4096), (8000, 192), (5, 0)]:
+        got = jax.jit(
+            lambda be, cn: segment_histograms(
+                words, jnp.asarray(ghc), be, cn, b, f=8)
+        )(jnp.int32(begin), jnp.int32(cnt))
+        ref = build_histograms(
+            jnp.asarray(bins[:, begin:begin + cnt]),
+            jnp.asarray(ghc[:, begin:begin + cnt].T), b,
+            row_chunk=max(cnt, 1))
+        np.testing.assert_allclose(np.asarray(got)[:f], np.asarray(ref),
+                                   rtol=1e-5, atol=1e-4)
+        # padded feature slots (f..4W-1) must stay zero except bin 0,
+        # which collects every row (padded features bin everything to 0)
+        assert np.all(np.asarray(got)[f:, 1:, :] == 0)
+
+
+def test_split_destinations_stable_partition():
+    rng = np.random.RandomState(2)
+    n = 257
+    go_left = rng.rand(n) > 0.4
+    begin, cnt = 31, 170
+    dest, n_left = jax.jit(split_destinations)(
+        jnp.asarray(go_left), jnp.int32(begin), jnp.int32(cnt))
+    dest = np.asarray(dest)
+    seg = np.arange(begin, begin + cnt)
+    expect_order = np.concatenate(
+        [seg[go_left[begin:begin + cnt]], seg[~go_left[begin:begin + cnt]]])
+    # dest maps old position -> new position; invert to compare order
+    src = np.asarray(invert_permutation(jnp.asarray(dest)))
+    np.testing.assert_array_equal(src[begin:begin + cnt], expect_order)
+    assert int(n_left) == int(go_left[begin:begin + cnt].sum())
+    # identity outside the segment
+    outside = np.setdiff1d(np.arange(n), seg)
+    np.testing.assert_array_equal(dest[outside], outside)
+    # applying the permutation keeps (words, ghc, perm) aligned
+    words = jnp.asarray(rng.randint(0, 2**31, size=(2, n), dtype=np.int32))
+    ghc = jnp.asarray(rng.rand(3, n).astype(np.float32))
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+    w2, g2, p2 = apply_partition(jnp.asarray(src), words, ghc, perm)
+    np.testing.assert_array_equal(np.asarray(w2), np.asarray(words)[:, src])
+    np.testing.assert_array_equal(np.asarray(p2), np.asarray(perm)[src])
+
+
+def _train(x, y, params, n_iter=8):
+    cfg = Config.from_params(params)
+    ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    objective = create_objective(cfg.objective, cfg)
+    objective.init(ds.metadata, ds.num_data)
+    booster = GBDT()
+    booster.init(cfg, ds, objective, [])
+    booster.train_many(n_iter)
+    return booster
+
+
+@pytest.mark.parametrize("use_fused", [True, False])
+def test_partitioned_matches_masked_trees(rng, use_fused):
+    n, f = 3000, 9
+    x = rng.rand(n, f).astype(np.float32)
+    logit = 3.0 * x[:, 0] - 2.0 * x[:, 1] + x[:, 2] * x[:, 3]
+    y = (logit + 0.3 * rng.randn(n) > 0.6).astype(np.float32)
+    base = {"objective": "binary", "num_leaves": 15, "max_bin": 64,
+            "min_data_in_leaf": 20, "metric": "binary_logloss",
+            "metric_freq": 0 if use_fused else 1}
+    n_iter = 6
+    b_mask = _train(x, y, dict(base, partitioned_build="false"), n_iter)
+    b_part = _train(x, y, dict(base, partitioned_build="true"), n_iter)
+    assert b_part.tree_learner._use_partitioned
+    assert not b_mask.tree_learner._use_partitioned
+    assert len(b_mask.models) == len(b_part.models)
+    for tm, tp in zip(b_mask.models, b_part.models):
+        np.testing.assert_array_equal(tm.split_feature, tp.split_feature)
+        np.testing.assert_array_equal(tm.threshold_in_bin, tp.threshold_in_bin)
+        np.testing.assert_array_equal(tm.left_child, tp.left_child)
+        np.testing.assert_allclose(tm.leaf_value, tp.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
+    pm = b_mask.predict(x)
+    pp = b_part.predict(x)
+    np.testing.assert_allclose(pm, pp, rtol=1e-4, atol=1e-5)
+
+
+def test_partitioned_binary_quality(rng):
+    n, f = 4000, 12
+    x = rng.rand(n, f).astype(np.float32)
+    y = ((x[:, 0] + x[:, 1] * x[:, 2] + 0.2 * rng.randn(n)) > 1.0).astype(
+        np.float32)
+    booster = _train(x, y, {
+        "objective": "binary", "num_leaves": 31, "metric": "auc",
+        "metric_freq": 0, "partitioned_build": "true"}, n_iter=30)
+    cfg = Config.from_params({"objective": "binary", "metric": "auc"})
+    m = create_metric("auc", cfg)
+    m.init(booster.train_data.metadata, booster.train_data.num_data)
+    auc = float(m.eval(booster.get_training_score())[0])
+    assert auc > 0.95, auc

@@ -1,0 +1,128 @@
+"""TPU primitive microbenchmarks for the partitioned-builder design.
+
+Measures the device primitives the leaf-contiguous (ordered-partition)
+tree builder depends on, so kernel/layout decisions are made from
+measured numbers instead of guesses:
+
+  - take_cols:   jnp.take along axis=1 of a (W, N) int32 word matrix
+                 (the bin permutation step; 4 uint8 features packed per
+                 int32 word)
+  - scatter_cols: zeros.at[:, perm].set(vals) for the same shape (the
+                 scatter formulation of the permutation)
+  - take_rows:   jnp.take along axis=0 of (N, W) (row-major layout)
+  - cumsum:      full-N f32 cumsum (stable-partition rank computation)
+  - argsort:     full-N int32 argsort (alternative partition route)
+  - masked_hist: the shipped pallas masked histogram (baseline, ~13.4ms
+                 at 1M x 28 x 256 from BASELINE.md)
+
+The axon tunnel memoizes repeated identical dispatches, so each op is
+timed as a K-step in-device `lax.scan` chain with a data dependency
+between steps (BASELINE.md "Measured" notes); reported time is chain
+wall-clock / K.
+
+Usage:  python tools/microbench.py [N] [K]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def chain_time(fn, init, k, label):
+    """Median-of-3 wall-clock of a k-step dependent scan chain / k."""
+
+    def step(carry, _):
+        return fn(carry), None
+
+    @jax.jit
+    def chained(x):
+        out, _ = jax.lax.scan(step, x, None, length=k)
+        return out
+
+    out = chained(init)
+    jax.block_until_ready(out)  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(chained(init))
+        times.append((time.perf_counter() - t0) / k)
+    ms = sorted(times)[1] * 1e3
+    print(f"{label:34s} {ms:8.3f} ms", flush=True)
+    return ms
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    f_words = 7  # 28 uint8 features packed 4-per-int32
+    rng = np.random.RandomState(0)
+
+    print(f"backend={jax.default_backend()} n={n} k={k}", flush=True)
+
+    words = jnp.asarray(rng.randint(0, 2**31, size=(f_words, n), dtype=np.int32))
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+
+    # permutation applied to the word matrix, chained via perm update
+    def take_cols(carry):
+        w, p = carry
+        return jnp.take(w, p, axis=1), jnp.roll(p, 1)
+
+    chain_time(take_cols, (words, perm), k, f"take_cols (7,{n}) i32")
+
+    def scatter_cols(carry):
+        w, p = carry
+        out = jnp.zeros_like(w).at[:, p].set(w)
+        return out, jnp.roll(p, 1)
+
+    chain_time(scatter_cols, (words, perm), k, f"scatter_cols (7,{n}) i32")
+
+    words_r = words.T.copy()
+
+    def take_rows(carry):
+        w, p = carry
+        return jnp.take(w, p, axis=0), jnp.roll(p, 1)
+
+    chain_time(take_rows, (words_r, perm), k, f"take_rows ({n},7) i32")
+
+    vec = jnp.asarray(rng.rand(n).astype(np.float32))
+    chain_time(lambda v: jnp.cumsum(v) * 1e-6, vec, k, f"cumsum ({n},) f32")
+
+    keys = jnp.asarray(rng.randint(0, 4, size=n, dtype=np.int32))
+
+    def argsorted(c):
+        return jnp.argsort(c, stable=True).astype(jnp.int32) % 4
+
+    chain_time(argsorted, keys, k, f"argsort ({n},) i32")
+
+    # one-per-row gather of f32 (ghc permutation, 3 stat rows)
+    ghc = jnp.asarray(rng.rand(3, n).astype(np.float32))
+
+    def take_ghc(carry):
+        g, p = carry
+        return jnp.take(g, p, axis=1), jnp.roll(p, 1)
+
+    chain_time(take_ghc, (ghc, perm), k, f"take_cols (3,{n}) f32")
+
+    # baseline: shipped masked histogram at the bench shape
+    from lightgbm_tpu.ops.pallas_hist import masked_histograms, HIST_CHUNK
+    f = 28
+    n_pad = ((n + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
+    bins = jnp.asarray(rng.randint(0, 255, size=(f, n_pad), dtype=np.uint8))
+    ghc_t = jnp.asarray(rng.rand(3, n_pad).astype(np.float32))
+    row_leaf = jnp.zeros(n_pad, dtype=jnp.int32)
+
+    def hist_step(carry):
+        rl, acc = carry
+        h, res = masked_histograms(bins, ghc_t, rl, jnp.int32(0), 256,
+                                   HIST_CHUNK)
+        return rl + (h[0, 0, 0] > -1).astype(jnp.int32), acc + h[0, 0, 0]
+
+    chain_time(hist_step, (row_leaf, jnp.float32(0)), k,
+               f"masked_hist ({f},{n_pad})x256")
+
+
+if __name__ == "__main__":
+    main()
