@@ -80,6 +80,13 @@ def make_data(n, f=N_FEATURES, seed=42):
     return x, y
 
 
+def _mark(msg):
+    """Timestamped phase marker on stderr: keeps a killed child's tail
+    diagnosable (BENCH_r02 died with no indication of the losing phase)."""
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
 def train_once(n_rows):
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import DatasetLoader
@@ -97,7 +104,9 @@ def train_once(n_rows):
         "metric_freq": 0,  # no eval inside the timed loop
     })
 
+    _mark(f"generating {n_rows} rows")
     x, y = make_data(n_rows)
+    _mark("constructing dataset (host binning + device put)")
     ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
     del x
 
@@ -106,18 +115,34 @@ def train_once(n_rows):
     booster = GBDT()
     booster.init(cfg, ds, objective, [])
 
+    # iterations per compiled scan: the block program is compiled once
+    # and called NUM_ITERATIONS/block times (same trees either way)
+    block = int(os.environ.get("BENCH_BLOCK_ITERS", NUM_ITERATIONS))
+    block = max(1, min(block, NUM_ITERATIONS))
+    # largest divisor of NUM_ITERATIONS <= requested: every call reuses
+    # the ONE compiled scan length and the tree count stays exact
+    while NUM_ITERATIONS % block != 0:
+        block -= 1
+
     # warm-up: AOT-compile the fused multi-iteration program (the normal
     # path for this config); if ineligible, compile the per-iteration
     # builder with one training round and roll it back so the timed model
     # has exactly NUM_ITERATIONS trees (AUC comparable to the baseline)
-    if not booster.warm_up_fused(NUM_ITERATIONS):
+    _mark(f"compiling fused {block}-iteration program")
+    if not booster.warm_up_fused(block):
         booster.train_one_iter(is_eval=False)
         booster.rollback_one_iter()
+    _mark("compile done, starting timed loop")
 
     t0 = time.time()
-    booster.train_many(NUM_ITERATIONS)
+    done = 0
+    while done < NUM_ITERATIONS:
+        step = min(block, NUM_ITERATIONS - done)
+        booster.train_many(step)
+        done += step
     np.asarray(booster.get_training_score())  # block on device work
     train_s = time.time() - t0
+    _mark(f"trained {NUM_ITERATIONS} iters in {train_s:.2f}s")
 
     auc_metric = create_metric("auc", cfg)
     auc_metric.init(ds.metadata, ds.num_data)
@@ -127,7 +152,20 @@ def train_once(n_rows):
 
 def run_child():
     """Child mode: one isolated measurement. Env: BENCH_CHILD_ROWS,
-    optional BENCH_CHILD_CPU / LIGHTGBM_TPU_DISABLE_PALLAS."""
+    optional BENCH_CHILD_CPU / LIGHTGBM_TPU_DISABLE_PALLAS /
+    BENCH_CHILD_WATCHDOG (graceful self-exit N seconds in, so the
+    TPU-tunnel session closes cleanly instead of dying to the parent's
+    SIGKILL — a killed client mid-RPC can wedge the shared tunnel)."""
+    import signal
+
+    wd = int(os.environ.get("BENCH_CHILD_WATCHDOG", "0"))
+    if wd > 0:
+        def bail(signum, frame):
+            _mark(f"watchdog: exceeding {wd}s, exiting gracefully")
+            raise SystemExit(3)
+        signal.signal(signal.SIGALRM, bail)
+        signal.alarm(wd)
+
     import jax
     if os.environ.get("BENCH_CHILD_CPU"):
         jax.config.update("jax_platforms", "cpu")
@@ -142,6 +180,10 @@ def measure(n_rows, timeout_s, force_cpu=False, disable_pallas=False):
     """Run one measurement in a subprocess. Returns (dict|None, note)."""
     env = dict(os.environ)
     env["BENCH_CHILD_ROWS"] = str(n_rows)
+    # graceful self-exit before the parent SIGKILL, keeping as much of
+    # the budget as possible (80% for small timeouts, -60s for large)
+    env.setdefault("BENCH_CHILD_WATCHDOG",
+                   str(max(timeout_s - 60, int(timeout_s * 0.8))))
     if force_cpu:
         env["BENCH_CHILD_CPU"] = "1"
     if disable_pallas:

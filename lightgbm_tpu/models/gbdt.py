@@ -305,6 +305,7 @@ class GBDT:
                                  jnp.zeros(pad, jnp.float32)])
 
         num_class = self.num_class
+        use_partitioned = getattr(learner, "_use_partitioned", False)
 
         def step(score, fmask):
             g, h = grad_fn(score)
@@ -313,7 +314,7 @@ class GBDT:
             if num_class == 1:
                 out = core(bins, gp[0], hp[0], inbag, fmask, nbpf, iscat)
                 upd = jnp.take(out["leaf_value"], out["row_leaf"][:n])[None, :]
-            else:
+            elif not use_partitioned:
                 # one device program for ALL classes: vmap the whole-tree
                 # builder over the class axis (SURVEY M2; the reference
                 # loops classes serially, gbdt.cpp:210-245)
@@ -323,6 +324,19 @@ class GBDT:
                 upd = jax.vmap(
                     lambda lv, rl: jnp.take(lv, rl[:n]))(
                         out["leaf_value"], out["row_leaf"])
+            else:
+                # partitioned builder: scan the class axis instead of
+                # vmap — vmapping its bucketed lax.switch would execute
+                # EVERY bucket branch per split; scan keeps one branch
+                # per class (still a single compiled program, matching
+                # the reference's sequential class loop)
+                def class_step(_, gh):
+                    gg, hh = gh
+                    o = core(bins, gg, hh, inbag, fmask, nbpf, iscat)
+                    u = jnp.take(o["leaf_value"], o["row_leaf"][:n])
+                    return None, (o, u)
+
+                _, (out, upd) = jax.lax.scan(class_step, None, (gp, hp))
             score = score + upd * shrink
             del out["row_leaf"]  # keep the stacked ys O(iter * num_leaves)
             return score, out

@@ -91,7 +91,8 @@ def init_split_state(l, root_split, root_c):
 
 def apply_tree_split(st, i, best_leaf, gain, l):
     """Tree bookkeeping for splitting `best_leaf` at iteration i
-    (Tree::Split, tree.cpp:51-97). Returns (st, node, right_id)."""
+    (Tree::Split, tree.cpp:51-97).
+    Returns (st, node, right_id, split_feature, split_threshold_bin)."""
     node = i  # splits happen on consecutive iterations
     right_id = i + 1  # new leaf id == num_leaves so far (tree.cpp:55)
     feat = st["best_feature"][best_leaf]
@@ -387,10 +388,9 @@ class SerialTreeLearner:
 
     def _partitioned_enabled(self, cfg):
         """Leaf-contiguous builder (models/partitioned.py): serial
-        learner only; "auto" turns it on for TPU backends. Multiclass
-        keeps the masked builder (its fused path vmaps the builder over
-        classes, and vmap of the bucketed `lax.switch` would execute
-        every bucket branch)."""
+        learner only; "auto" turns it on for TPU backends. Needs an
+        unbundled dataset (bundling's expand/decode hooks are only
+        wired into the masked builder) and uint8-storable bins."""
         if type(self) is not SerialTreeLearner:
             return False
         mode = str(getattr(cfg, "partitioned_build", "auto")).lower()
@@ -400,12 +400,11 @@ class SerialTreeLearner:
             Log.fatal('partitioned_build must be "auto", "true" or '
                       '"false", got [%s]', mode)
         eligible = (self._bundle is None
-                    and int(self.train_set.max_stored_bin) <= 256
-                    and int(getattr(cfg, "num_class", 1)) == 1)
+                    and int(self.train_set.max_stored_bin) <= 256)
         if mode in ("true", "1", "on", "+"):
             if not eligible:
                 Log.warning("partitioned_build=true ignored: needs an "
-                            "unbundled dataset, max_bin <= 256, num_class=1")
+                            "unbundled dataset and max_bin <= 256")
             return eligible
         return eligible and jax.default_backend() == "tpu"
 

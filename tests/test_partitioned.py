@@ -121,6 +121,29 @@ def test_partitioned_matches_masked_trees(rng, use_fused):
     np.testing.assert_allclose(pm, pp, rtol=1e-4, atol=1e-5)
 
 
+def test_partitioned_multiclass_fused_matches_masked(rng):
+    """Multiclass fused training scans the class axis under the
+    partitioned builder (vmap would run every lax.switch branch);
+    trees must match the masked builder's vmap path."""
+    n, f, k = 2400, 6, 3
+    x = rng.rand(n, f).astype(np.float32)
+    y = (x[:, 0] * 3 + x[:, 1] * 2).astype(np.int32) % k
+    base = {"objective": "multiclass", "num_class": k, "num_leaves": 7,
+            "max_bin": 32, "min_data_in_leaf": 10, "metric_freq": 0}
+    n_iter = 3
+    bm = _train(x, y.astype(np.float32), dict(base, partitioned_build="false"),
+                n_iter)
+    bp = _train(x, y.astype(np.float32), dict(base, partitioned_build="true"),
+                n_iter)
+    assert bp.tree_learner._use_partitioned
+    assert len(bm.models) == len(bp.models) == n_iter * k
+    for tm, tp in zip(bm.models, bp.models):
+        np.testing.assert_array_equal(tm.split_feature, tp.split_feature)
+        np.testing.assert_array_equal(tm.threshold_in_bin, tp.threshold_in_bin)
+    np.testing.assert_allclose(bm.predict(x), bp.predict(x),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_partitioned_binary_quality(rng):
     n, f = 4000, 12
     x = rng.rand(n, f).astype(np.float32)

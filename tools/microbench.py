@@ -23,12 +23,15 @@ wall-clock / K.
 Usage:  python tools/microbench.py [N] [K]
 """
 
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def chain_time(fn, init, k, label):
@@ -122,6 +125,24 @@ def main():
 
     chain_time(hist_step, (row_leaf, jnp.float32(0)), k,
                f"masked_hist ({f},{n_pad})x256")
+
+    # the partitioned path's segment histogram at several leaf sizes
+    from lightgbm_tpu.ops.ordered_hist import (pack_feature_words,
+                                               segment_histograms)
+    words28 = jnp.asarray(pack_feature_words(
+        rng.randint(0, 255, size=(f, n_pad), dtype=np.uint8)))
+    for seg in [HIST_CHUNK, 16 * HIST_CHUNK, n_pad]:
+        seg = min(seg, n_pad)
+
+        def seg_step(carry, seg=seg):
+            b, acc = carry
+            h = segment_histograms(words28, ghc_t, b, jnp.int32(seg),
+                                   256, f=28)
+            return (b + (h[0, 0, 0] > -1).astype(jnp.int32) - 1,
+                    acc + h[0, 0, 0])
+
+        chain_time(seg_step, (jnp.int32(1), jnp.float32(0)), k,
+                   f"segment_hist seg={seg}")
 
 
 if __name__ == "__main__":
