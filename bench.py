@@ -102,6 +102,10 @@ def train_once(n_rows):
         "num_iterations": NUM_ITERATIONS,
         "metric": "auc",
         "metric_freq": 0,  # no eval inside the timed loop
+        # leaf-contiguous builder on every backend (auto = TPU only):
+        # histogram cost scales with leaf size, ~20x less streaming at
+        # 63 leaves (models/partitioned.py)
+        "partitioned_build": "true",
     })
 
     _mark(f"generating {n_rows} rows")

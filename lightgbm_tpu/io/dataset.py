@@ -279,7 +279,12 @@ class DatasetLoader:
         # training needs raw values for init scores, so it keeps the
         # in-memory path.
         if cfg.use_two_round_loading and self.predict_fun is None:
-            ds = self._load_two_round(filename)
+            ds = self._load_two_round(filename, rank, num_machines)
+            if ds.global_num_data is not None:
+                if cfg.is_save_binary_file:
+                    Log.warning("is_save_binary_file ignored: rank-"
+                                "filtered datasets hold only a row block")
+                return ds  # already rank-filtered during the stream
             if cfg.is_save_binary_file and rank == 0 and not cache_incompatible:
                 ds.save_binary(bin_path)  # one writer on shared storage
             return self._apply_rank_partition(ds, rank, num_machines)
@@ -331,9 +336,18 @@ class DatasetLoader:
         return ds
 
     # ------------------------------------------------- two-round streaming
-    def _load_two_round(self, filename) -> CoreDataset:
+    def _load_two_round(self, filename, rank=0, num_machines=1) -> CoreDataset:
         """Sample pass -> mappers -> binning pass (dataset_loader.cpp:505-610,
-        pipeline_reader.h/text_reader.h semantics; see io/streaming.py)."""
+        pipeline_reader.h/text_reader.h semantics; see io/streaming.py).
+
+        Under jax.distributed, round two is RANK-FILTERED
+        (dataset_loader.cpp:505-550): every rank streams the file but
+        stores only its contiguous row block, so peak memory is
+        O(block + local rows + sample). The bin-construction sample is
+        drawn from the GLOBAL stream with the shared data_random_seed,
+        so every rank derives identical mappers with no network — the
+        TPU answer to the reference's mapper Allgather
+        (dataset_loader.cpp:697-760)."""
         from .parser import detect_format
         from .streaming import scan_file, iter_blocks, collect_sample_rows
         cfg = self.config
@@ -387,36 +401,64 @@ class DatasetLoader:
             if plan.is_identity:
                 plan = None
 
+        # rank filtering: only this rank's contiguous row block is stored
+        # (query-grouped data and side files need global views — those
+        # fall back to full-load + subset in _apply_rank_partition)
+        import jax
+        from .metadata import SIDE_FILE_EXTS
+        side_files = any(os.path.exists(str(filename) + ext)
+                         for ext in SIDE_FILE_EXTS)
+        rank_filter = (num_machines > 1
+                       and jax.process_count() == num_machines
+                       and rank < num_machines
+                       and not cfg.is_pre_partition
+                       and cfg.tree_learner != "feature"
+                       and group_idx < 0 and not side_files)
+        if rank_filter:
+            from ..parallel.distributed import partition_rows
+            lo, hi = partition_rows(n, rank, num_machines)
+            n_local = hi - lo
+        else:
+            lo, hi = 0, n
+            n_local = n
+
         # round two: stream blocks, pushing binned values + metadata columns
         if plan is None:
             dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
                      else np.uint16)
-            bins = np.empty((len(mappers), n), dtype=dtype)
+            bins = np.empty((len(mappers), n_local), dtype=dtype)
         else:
             dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
                      else np.uint16)
-            bins = np.zeros((plan.num_slots, n), dtype=dtype)
-        label = np.empty(n, dtype=np.float32)
-        weights = np.empty(n, dtype=np.float32) if weight_idx >= 0 else None
-        qid = np.empty(n, dtype=np.float64) if group_idx >= 0 else None
+            bins = np.zeros((plan.num_slots, n_local), dtype=dtype)
+        label = np.empty(n_local, dtype=np.float32)
+        weights = np.empty(n_local, dtype=np.float32) if weight_idx >= 0 else None
+        qid = np.empty(n_local, dtype=np.float64) if group_idx >= 0 else None
         bundle_conflicts = 0
         for start, block in iter_blocks(filename, fmt, cfg.has_header,
                                         num_cols):
             end = start + len(block)
-            label[start:end] = block[:, label_idx]
+            if start >= hi:
+                break  # past this rank's range: skip the rest of the file
+            s0, e0 = max(start, lo), min(end, hi)
+            if e0 <= s0:
+                continue  # block before this rank's range
+            block = block[s0 - start:e0 - start]
+            ls, le = s0 - lo, e0 - lo   # local write positions
+            label[ls:le] = block[:, label_idx]
             feats_block = block[:, feat_cols]
             if weights is not None:
-                weights[start:end] = feats_block[:, weight_idx]
+                weights[ls:le] = feats_block[:, weight_idx]
             if qid is not None:
-                qid[start:end] = feats_block[:, group_idx]
+                qid[ls:le] = feats_block[:, group_idx]
             for u, j in enumerate(real_idx):
                 col = mappers[u].value_to_bin(feats_block[:, j])
                 if plan is None:
-                    bins[u, start:end] = col.astype(dtype)
+                    bins[u, ls:le] = col.astype(dtype)
                 else:
                     s = plan.feat_slot[u]
                     off = plan.feat_offset[u]
-                    seg = bins[s, start:end]
+                    seg = bins[s, ls:le]
                     nz = col > 0
                     bundle_conflicts += int((nz & (seg != 0)).sum())
                     write = nz & (seg == 0)
@@ -436,7 +478,7 @@ class DatasetLoader:
         ds.real_feature_idx = np.asarray(real_idx, dtype=np.int32)
         ds.label_idx = label_idx
 
-        meta = Metadata(n)
+        meta = Metadata(n_local)
         meta.set_label(label)
         if weights is not None:
             meta.set_weights(weights)
@@ -444,8 +486,17 @@ class DatasetLoader:
             meta.set_query(_qid_to_counts(qid))
         meta.load_side_files(filename)
         ds.metadata = meta
+        if rank_filter:
+            from ..parallel.distributed import partition_rows
+            ds.global_num_data = n
+            ds.local_rows_max = max(
+                partition_rows(n, r, num_machines)[1]
+                - partition_rows(n, r, num_machines)[0]
+                for r in range(num_machines))
+            Log.info("Rank %d/%d streamed rows [%d, %d) of %d (two-round)",
+                     rank, num_machines, lo, hi, n)
         Log.info("Number of data: %d, number of features: %d (two-round)",
-                 n, len(mappers))
+                 n_local, len(mappers))
         return ds
 
     # --------------------------------------------------------- from matrix

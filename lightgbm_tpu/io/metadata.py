@@ -12,6 +12,11 @@ import numpy as np
 
 from ..utils.log import Log
 
+# per-row side files auto-loaded next to the data file; anything that
+# partitions rows (io/dataset.py rank filtering) must treat data with
+# ANY of these as global-length
+SIDE_FILE_EXTS = (".weight", ".query", ".init")
+
 
 class Metadata:
     def __init__(self, num_data=0):
@@ -24,9 +29,9 @@ class Metadata:
 
     # ------------------------------------------------------------ side files
     def load_side_files(self, data_filename):
-        wf = str(data_filename) + ".weight"
-        qf = str(data_filename) + ".query"
-        inf = str(data_filename) + ".init"
+        wf = str(data_filename) + SIDE_FILE_EXTS[0]
+        qf = str(data_filename) + SIDE_FILE_EXTS[1]
+        inf = str(data_filename) + SIDE_FILE_EXTS[2]
         if os.path.exists(wf):
             self.set_weights(np.loadtxt(wf, dtype=np.float32, ndmin=1))
             Log.info("Loading weights...")

@@ -73,6 +73,21 @@ def init_from_config(config):
         return False
     if config is None or config.num_machines <= 1 or not config.machine_list_file:
         return False
+    if not os.path.exists(config.machine_list_file):
+        if os.environ.get("LIGHTGBM_TPU_RANK") is not None:
+            # explicit multi-process launch: training solo here while
+            # peers block in jax.distributed.initialize would deadlock
+            # the job — die fast like the reference's socket linker
+            Log.fatal("machine_list_file %s not found (rank %s)",
+                      config.machine_list_file,
+                      os.environ["LIGHTGBM_TPU_RANK"])
+        # single-process run of a distributed conf (e.g. the reference's
+        # examples/parallel_learning out of the box): model num_machines
+        # with local mesh devices (parallel/learners.py make_mesh)
+        Log.warning("machine_list_file %s not found; running single-"
+                    "process with %d mesh devices",
+                    config.machine_list_file, config.num_machines)
+        return False
     machines = parse_machine_list(config.machine_list_file)
     if len(machines) < config.num_machines:
         Log.fatal("Machine list file only contains %d machines, but "

@@ -18,6 +18,13 @@ import jax  # noqa: E402
 # JAX_PLATFORMS; override the platform choice explicitly.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's cost is dominated by jitted
+# tree-builder recompiles per config permutation; a warm cache cuts the
+# wall-clock ~40%.
+_cache = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -19,12 +19,19 @@ def main():
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.objectives import create_objective
 
-    cfg = Config.from_params({
+    import os
+    data_path = os.environ.get(
+        "LIGHTGBM_TPU_TEST_DATA",
+        "/root/reference/examples/binary_classification/binary.train")
+    params = {
         "objective": "binary", "num_leaves": 15, "num_iterations": 5,
         "tree_learner": "data", "num_machines": 2,
         "machine_list_file": mlist, "min_data_in_leaf": 20,
         "metric_freq": 0, "enable_load_from_binary_file": False,
-    })
+    }
+    if os.environ.get("LIGHTGBM_TPU_TEST_TWO_ROUND"):
+        params["use_two_round_loading"] = True
+    cfg = Config.from_params(params)
     init_from_config(cfg)
 
     import jax
@@ -32,9 +39,12 @@ def main():
     assert len(jax.devices()) == 4, jax.devices()
 
     ds = DatasetLoader(cfg).load_from_file(
-        "/root/reference/examples/binary_classification/binary.train",
-        rank=jax.process_index(), num_machines=2)
-    assert ds.global_num_data == 7000, ds.global_num_data
+        data_path, rank=jax.process_index(), num_machines=2)
+    expect_n = os.environ.get("LIGHTGBM_TPU_TEST_GLOBAL_ROWS")
+    if expect_n:
+        assert ds.global_num_data == int(expect_n), ds.global_num_data
+        # rank-filtered streaming must hold ONLY the local block
+        assert ds.num_data < int(expect_n), ds.num_data
     obj = create_objective(cfg.objective, cfg)
     obj.init(ds.metadata, ds.num_data)
     b = GBDT()

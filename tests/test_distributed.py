@@ -26,7 +26,7 @@ def _free_port():
     return port
 
 
-def test_two_process_data_parallel_matches_single(tmp_path):
+def _run_two_workers(tmp_path, extra_env=None):
     port = _free_port()
     mlist = tmp_path / "mlist.txt"
     mlist.write_text(f"127.0.0.1 {port}\n127.0.0.1 {port + 1}\n")
@@ -42,6 +42,7 @@ def test_two_process_data_parallel_matches_single(tmp_path):
             "LIGHTGBM_TPU_RANK": str(rank),
             "PYTHONPATH": os.path.dirname(os.path.dirname(__file__)),
         })
+        env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [sys.executable, worker, str(rank), str(mlist), str(out_model)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -53,6 +54,15 @@ def test_two_process_data_parallel_matches_single(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
         assert f"WORKER_DONE rank {rank}" in out
+    return out_model
+
+
+def test_two_process_data_parallel_matches_single(tmp_path):
+    # GLOBAL_ROWS makes the worker assert global_num_data==7000 and that
+    # each rank holds a strict subset (catches a silently-unset rank
+    # partition that would train on replicated full data)
+    out_model = _run_two_workers(
+        tmp_path, extra_env={"LIGHTGBM_TPU_TEST_GLOBAL_ROWS": "7000"})
 
     # single-process reference run (2 local devices, full data)
     from lightgbm_tpu.config import Config
@@ -78,6 +88,54 @@ def test_two_process_data_parallel_matches_single(tmp_path):
     assert len(dist.models) == len(b.models) == 5
     for t_dist, t_local in zip(dist.models, b.models):
         assert t_dist.num_leaves == t_local.num_leaves
+        np.testing.assert_array_equal(t_dist.split_feature_real,
+                                      t_local.split_feature_real)
+        np.testing.assert_allclose(t_dist.threshold, t_local.threshold,
+                                   rtol=1e-12)
+        np.testing.assert_allclose(t_dist.leaf_value, t_local.leaf_value,
+                                   rtol=2e-4, atol=1e-7)
+
+
+def test_two_round_rank_filtered_streaming_matches_single(tmp_path):
+    """Rank-filtered two-round loading: each rank streams the file but
+    stores only its row block (dataset_loader.cpp:505-550); mappers come
+    from the shared global sample, so 2-process training still produces
+    the single-process trees."""
+    rng = np.random.RandomState(11)
+    n, f = 2000, 6
+    x = rng.rand(n, f)
+    y = ((x[:, 0] + x[:, 1] * x[:, 2]) > 0.9).astype(int)
+    csv = tmp_path / "tr.csv"
+    np.savetxt(csv, np.column_stack([y, x]), delimiter=",", fmt="%.6f")
+
+    out_model = _run_two_workers(tmp_path, extra_env={
+        "LIGHTGBM_TPU_TEST_DATA": str(csv),
+        "LIGHTGBM_TPU_TEST_TWO_ROUND": "1",
+        "LIGHTGBM_TPU_TEST_GLOBAL_ROWS": str(n),
+    })
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    from lightgbm_tpu.models.gbdt import GBDT, create_boosting
+    from lightgbm_tpu.objectives import create_objective
+
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 15, "num_iterations": 5,
+        "tree_learner": "data", "min_data_in_leaf": 20, "metric_freq": 0,
+        "enable_load_from_binary_file": False,
+    })
+    ds = DatasetLoader(cfg).load_from_file(str(csv))
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    b = GBDT()
+    b.init(cfg, ds, obj, [])
+    for _ in range(cfg.num_iterations):
+        b.train_one_iter(is_eval=False)
+
+    dist = create_boosting("gbdt")
+    dist.load_model_from_string(out_model.read_text())
+    assert len(dist.models) == len(b.models) == 5
+    for t_dist, t_local in zip(dist.models, b.models):
         np.testing.assert_array_equal(t_dist.split_feature_real,
                                       t_local.split_feature_real)
         np.testing.assert_allclose(t_dist.threshold, t_local.threshold,
