@@ -50,7 +50,7 @@ def unpack_feature(words, feat):
     return (word >> ((feat & 3) * 8)) & 0xFF
 
 
-def _bucket_sizes(n_chunks):
+def bucket_sizes(n_chunks):
     """Power-of-two chunk buckets up to the full array."""
     sizes = []
     b = 1
@@ -59,6 +59,26 @@ def _bucket_sizes(n_chunks):
         b *= 2
     sizes.append(n_chunks)
     return sizes
+
+
+def cover_index(begin, cnt, n_chunks):
+    """Chunk-cover dispatch shared by segment_histograms and the
+    partition step (models/partitioned.py _partition_segment): the
+    `lax.switch` bucket index + first covered chunk for the position
+    range [begin, begin+cnt). Both consumers MUST window with
+    `window_start` so their slices agree."""
+    c_first = begin // HIST_CHUNK
+    c_last = (begin + jnp.maximum(cnt, 1) - 1) // HIST_CHUNK
+    needed = c_last - c_first + 1
+    idx = jnp.searchsorted(
+        jnp.asarray(bucket_sizes(n_chunks), dtype=jnp.int32), needed)
+    return idx, c_first
+
+
+def window_start(c_first, bk, n_chunks):
+    """First ROW of the bk-chunk window at c_first, clipped in-bounds
+    (a pulled-back window still covers the range; see cover_index)."""
+    return jnp.clip(c_first, 0, n_chunks - bk) * HIST_CHUNK
 
 
 def _seg_hist_kernel(lohi_ref, words_ref, ghc_ref, out_ref, *, f, b_pad):
@@ -139,22 +159,18 @@ def segment_histograms(words, ghc_t, begin, cnt, num_bins_total, f,
     if n % HIST_CHUNK != 0:
         raise ValueError(f"N={n} must be a multiple of {HIST_CHUNK}")
     n_chunks = n // HIST_CHUNK
-    buckets = _bucket_sizes(n_chunks)
+    buckets = bucket_sizes(n_chunks)
 
     begin = begin.astype(jnp.int32)
     cnt = jnp.maximum(cnt, 0).astype(jnp.int32)
-    c_first = begin // HIST_CHUNK
-    c_last = (begin + jnp.maximum(cnt, 1) - 1) // HIST_CHUNK
-    needed = c_last - c_first + 1
-    idx = jnp.searchsorted(jnp.asarray(buckets, dtype=jnp.int32), needed)
+    idx, c_first = cover_index(begin, cnt, n_chunks)
 
     on_tpu = (jax.default_backend() == "tpu"
               if interpret_backend is None else interpret_backend == "tpu")
 
     def make_branch(bk):
         def branch(begin, cnt):
-            c0 = jnp.clip(c_first, 0, n_chunks - bk)
-            start = c0 * HIST_CHUNK
+            start = window_start(c_first, bk, n_chunks)
             words_sl = jax.lax.dynamic_slice(
                 words, (jnp.int32(0), start), (w, bk * HIST_CHUNK))
             ghc_sl = jax.lax.dynamic_slice(

@@ -84,6 +84,41 @@ def test_split_destinations_stable_partition():
     np.testing.assert_array_equal(np.asarray(p2), np.asarray(perm)[src])
 
 
+def test_partition_segment_matches_full_array():
+    """The bucketed segment partition (models/partitioned.py) must equal
+    the full-array stable partition on multi-chunk arrays, including
+    chunk-crossing and clipped-window segments."""
+    from lightgbm_tpu.models.partitioned import _partition_segment
+    from lightgbm_tpu.ops.pallas_hist import HIST_CHUNK
+
+    rng = np.random.RandomState(5)
+    n = 3 * HIST_CHUNK
+    f = 5
+    bins = rng.randint(0, 16, size=(f, n), dtype=np.uint8)
+    words = jnp.asarray(pack_feature_words(bins))
+    ghc = jnp.asarray(rng.rand(3, n).astype(np.float32))
+    perm = jnp.asarray(rng.permutation(n).astype(np.int32))
+
+    for seg_b, seg_c in [(0, n), (100, HIST_CHUNK), (4000, 300),
+                         (HIST_CHUNK - 5, 10), (2 * HIST_CHUNK, HIST_CHUNK),
+                         (n - 200, 200), (37, 2 * HIST_CHUNK + 9)]:
+        feat, thr = 2, 7
+        w2, g2, p2, nl2 = jax.jit(
+            lambda b, c: _partition_segment(
+                words, ghc, perm, b, c, jnp.int32(feat), jnp.int32(thr),
+                jnp.asarray(False)))(jnp.int32(seg_b), jnp.int32(seg_c))
+        # reference: full-array stable partition
+        go_left = jnp.asarray(bins[feat] <= thr)
+        dest, nl_ref = split_destinations(
+            go_left, jnp.int32(seg_b), jnp.int32(seg_c))
+        src = invert_permutation(dest)
+        w_ref, g_ref, p_ref = apply_partition(src, words, ghc, perm)
+        assert int(nl2) == int(nl_ref), (seg_b, seg_c)
+        np.testing.assert_array_equal(np.asarray(w2), np.asarray(w_ref))
+        np.testing.assert_array_equal(np.asarray(g2), np.asarray(g_ref))
+        np.testing.assert_array_equal(np.asarray(p2), np.asarray(p_ref))
+
+
 def _train(x, y, params, n_iter=8):
     cfg = Config.from_params(params)
     ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
@@ -145,7 +180,9 @@ def test_partitioned_multiclass_fused_matches_masked(rng):
 
 
 def test_partitioned_binary_quality(rng):
-    n, f = 4000, 12
+    # n > 2 chunks so the end-to-end builder exercises the multi-chunk
+    # windows of both segment_histograms and _partition_segment
+    n, f = 9000, 12
     x = rng.rand(n, f).astype(np.float32)
     y = ((x[:, 0] + x[:, 1] * x[:, 2] + 0.2 * rng.randn(n)) > 1.0).astype(
         np.float32)
