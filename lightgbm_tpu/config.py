@@ -204,6 +204,9 @@ class Config:
     early_stopping_round: int = 0
     drop_rate: float = 0.01
     drop_seed: int = 4
+    # GOSS (post-reference extension, models/goss.py)
+    top_rate: float = 0.2
+    other_rate: float = 0.1
 
     # --- network (config.h:219-226) ---
     num_machines: int = 1
@@ -275,8 +278,8 @@ class Config:
             b = str(params["boosting_type"]).lower()
             if b in ("gbdt", "gbrt"):
                 cfg.boosting_type = "gbdt"
-            elif b == "dart":
-                cfg.boosting_type = "dart"
+            elif b in ("dart", "goss"):
+                cfg.boosting_type = b
             else:
                 Log.fatal("Unknown boosting type %s", b)
         if "objective" in params:

@@ -169,8 +169,10 @@ class GBDT:
             self.best_msg.append([""] * len(valid_metrics))
 
     # --------------------------------------------------------------- bagging
-    def _bagging(self, it):
-        """gbdt.cpp:150-201; returns in-bag float mask or None."""
+    def _bagging(self, it, gradients=None, hessians=None):
+        """gbdt.cpp:150-201; returns in-bag float mask or None.
+        gradients/hessians are provided for gradient-based sampling
+        strategies (models/goss.py); plain bagging ignores them."""
         cfg = self.config
         if not (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0):
             return None
@@ -211,7 +213,7 @@ class GBDT:
             hessians = np.asarray(hessians, dtype=np.float32).reshape(
                 self.num_class, self.num_data)
         with TIMERS.phase("bagging"):
-            inbag = self._bagging(self.iter)
+            inbag = self._bagging(self.iter, gradients, hessians)
         n = self.num_data
         multi_host = getattr(self.tree_learner, "n_proc", 1) > 1
         for k in range(self.num_class):
@@ -747,14 +749,19 @@ class GBDT:
 
 
 def create_boosting(boosting_type, input_model=""):
-    """Factory + model-file type sniffing (src/boosting/boosting.cpp:7-66)."""
+    """Factory + model-file type sniffing (src/boosting/boosting.cpp:7-66).
+    "goss" is a post-reference extension (models/goss.py)."""
     from .dart import DART
+    from .goss import GOSS
     if input_model:
         with open(input_model) as f:
             first = f.readline().strip()
-        boosting_type = first if first in ("gbdt", "dart") else boosting_type
+        boosting_type = (first if first in ("gbdt", "dart", "goss")
+                         else boosting_type)
     if boosting_type == "gbdt":
         return GBDT()
     if boosting_type == "dart":
         return DART()
+    if boosting_type == "goss":
+        return GOSS()
     Log.fatal("Unknown boosting type %s", boosting_type)

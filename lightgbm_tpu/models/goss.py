@@ -1,0 +1,65 @@
+"""GOSS (Gradient-based One-Side Sampling) boosting.
+
+NOT part of the v0 reference snapshot (SURVEY.md: GOSS/EFB arrived with
+the NeurIPS-2017 LightGBM paper) — an additive extension following the
+paper's algorithm: keep the top_rate fraction of rows by gradient
+magnitude, sample other_rate of the rest uniformly, and amplify the
+sampled small-gradient rows by (1 - top_rate) / other_rate so split
+gains stay unbiased. Fits this framework as a fractional in-bag weight
+vector: the builders already multiply gradient/hessian/count columns by
+`inbag` (models/tree_learner.py), so amplified rows contribute weighted
+statistics — including weighted counts, so min_data_in_leaf acts on
+effective (weighted) rows under GOSS; out-of-bag rows still receive
+score updates through the full-row partition.
+
+Row score = sum over classes of |g * h| with a plain-boosting warm-up
+of ceil(1 / learning_rate) iterations, both per the paper's reference
+implementation.
+"""
+
+import numpy as np
+
+from ..utils.log import Log
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    name = "goss"
+
+    def init(self, config, train_data, objective, training_metrics=()):
+        super().init(config, train_data, objective, training_metrics)
+        if not (0.0 <= config.top_rate <= 1.0
+                and 0.0 <= config.other_rate <= 1.0
+                and config.top_rate + config.other_rate <= 1.0):
+            Log.fatal("GOSS needs top_rate >= 0, other_rate >= 0 and "
+                      "top_rate + other_rate <= 1.0 (got %g, %g)",
+                      config.top_rate, config.other_rate)
+        if config.bagging_fraction < 1.0 and config.bagging_freq > 0:
+            Log.fatal("Cannot use bagging in GOSS (bagging_fraction/"
+                      "bagging_freq conflict with gradient-based sampling)")
+        self._warmup = int(np.ceil(1.0 / max(config.learning_rate, 1e-6)))
+
+    def _bagging(self, it, gradients=None, hessians=None):
+        cfg = self.config
+        if it < self._warmup or gradients is None:
+            return None
+        n = self.num_data
+        g = np.abs(np.asarray(gradients, dtype=np.float64)
+                   * np.asarray(hessians, dtype=np.float64))
+        score = g.reshape(self.num_class, n).sum(axis=0)
+        top_n = max(1, int(cfg.top_rate * n))
+        rand_n = int(cfg.other_rate * n)
+        # threshold of the top_n-th largest score (ties land in the top set)
+        thr = np.partition(score, n - top_n)[n - top_n]
+        top = score >= thr
+        rest = ~top
+        n_rest = int(rest.sum())
+        mask = np.zeros(n, dtype=np.float32)
+        mask[top] = 1.0
+        if rand_n > 0 and n_rest > 0:
+            amp = (1.0 - cfg.top_rate) / cfg.other_rate
+            u = self.random._rng.random_sample(n)
+            mask[rest & (u < rand_n / n_rest)] = amp
+        Log.debug("GOSS: %d top + ~%d sampled rows of %d",
+                  int(top.sum()), rand_n, n)
+        return mask

@@ -1,0 +1,83 @@
+"""GOSS boosting (post-reference extension, models/goss.py): sampling
+structure and accuracy parity with full-data GBDT."""
+
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import DatasetLoader
+from lightgbm_tpu.metrics import create_metric
+from lightgbm_tpu.models.gbdt import create_boosting
+from lightgbm_tpu.objectives import create_objective
+
+
+def _train(x, y, params, n_iter):
+    cfg = Config.from_params(params)
+    ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    objective = create_objective(cfg.objective, cfg)
+    objective.init(ds.metadata, ds.num_data)
+    b = create_boosting(cfg.boosting_type)
+    b.init(cfg, ds, objective, [])
+    for _ in range(n_iter):
+        b.train_one_iter(is_eval=False)
+    return b
+
+
+def test_goss_mask_structure():
+    rng = np.random.RandomState(42)
+    n, f = 3000, 8
+    x = rng.rand(n, f).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0.8).astype(np.float32)
+    params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+              "learning_rate": 0.5, "top_rate": 0.2, "other_rate": 0.1,
+              "metric_freq": 0}
+    b = _train(x, y, params, 1)
+    assert type(b).__name__ == "GOSS"
+    # warm-up (ceil(1/lr)=2 iters): no sampling yet
+    g = np.full((1, n), 0.3, np.float32)
+    h = np.ones((1, n), np.float32)
+    assert b._bagging(0, g, h) is None
+    # after warm-up: top 20% weight 1, sampled rest amplified by
+    # (1-0.2)/0.1 = 8, everything else 0
+    score = rng.rand(n).astype(np.float32)
+    mask = b._bagging(5, score[None, :], h)
+    top = score >= np.partition(score, n - 600)[n - 600]
+    np.testing.assert_array_equal(mask[top], 1.0)
+    rest_vals = np.unique(mask[~top])
+    assert set(np.round(rest_vals, 5)) <= {0.0, 8.0}
+    n_sampled = int((mask[~top] > 0).sum())
+    assert 150 <= n_sampled <= 450  # ~other_rate * n = 300
+
+def test_goss_accuracy_close_to_full():
+    rng = np.random.RandomState(42)
+    n, f = 6000, 10
+    x = rng.rand(n, f).astype(np.float32)
+    y = ((x[:, 0] + x[:, 1] * x[:, 2] + 0.1 * rng.randn(n)) > 1.0).astype(
+        np.float32)
+    base = {"objective": "binary", "num_leaves": 31, "metric": "auc",
+            "metric_freq": 0, "min_data_in_leaf": 20}
+    bf = _train(x, y, dict(base), 30)
+    bg = _train(x, y, dict(base, boosting="goss"), 30)
+    cfg = Config.from_params(base)
+    m = create_metric("auc", cfg)
+    m.init(bf.train_data.metadata, n)
+    auc_full = float(m.eval(bf.get_training_score())[0])
+    auc_goss = float(m.eval(bg.get_training_score())[0])
+    assert auc_goss > 0.95, auc_goss
+    assert abs(auc_full - auc_goss) < 0.02, (auc_full, auc_goss)
+
+
+def test_goss_model_roundtrip(tmp_path):
+    rng = np.random.RandomState(42)
+    n, f = 2000, 6
+    x = rng.rand(n, f).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.float32)
+    b = _train(x, y, {"objective": "binary", "boosting": "goss",
+                      "num_leaves": 7, "metric_freq": 0}, 5)
+    path = str(tmp_path / "goss.txt")
+    b.save_model_to_file(-1, path)
+    with open(path) as fh:
+        assert fh.readline().strip() == "goss"
+    b2 = create_boosting("gbdt", input_model=path)  # sniffed back to goss
+    assert type(b2).__name__ == "GOSS"
+    b2.load_model_from_string(open(path).read())
+    np.testing.assert_allclose(b.predict(x), b2.predict(x), rtol=1e-12)

@@ -97,3 +97,35 @@ def test_split_choice_matches_f64(workload):
                 got32 = (feat, thr)
             else:
                 assert got32 == (feat, thr), (got32, (feat, thr))
+
+
+def test_segment_histogram_matches_f64(workload):
+    """Partitioned-path accumulation (ops/ordered_hist.py): plain f32
+    per-segment sums over <= leaf-sized chunk buckets must stay within
+    a few ulps of f64 at the 1M scale (the segments are smaller than
+    the masked path's full-N streams, so the bound is easier)."""
+    from lightgbm_tpu.ops.ordered_hist import (pack_feature_words,
+                                               segment_histograms)
+    from lightgbm_tpu.ops.pallas_hist import HIST_CHUNK
+
+    bins, ghc_t, row_leaf = workload
+    n = bins.shape[1]
+    n_pad = ((n + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
+    bins_p = np.zeros((F, n_pad), np.uint8)
+    bins_p[:, :n] = bins
+    ghc_p = np.zeros((3, n_pad), np.float32)
+    ghc_p[:, :n] = ghc_t
+    words = jnp.asarray(pack_feature_words(bins_p))
+
+    begin, cnt = 0, n  # root-sized segment: the worst accumulation case
+    got = jax.jit(lambda b, c: segment_histograms(
+        words, jnp.asarray(ghc_p), b, c, B, f=F))(
+            jnp.int32(begin), jnp.int32(cnt))
+    want = np.zeros((F, B, 3))
+    for k in range(3):
+        w = ghc_p[k, begin:begin + cnt].astype(np.float64)
+        for f in range(F):
+            want[f, :, k] = np.bincount(
+                bins_p[f, begin:begin + cnt], weights=w, minlength=B)[:B]
+    err = np.abs(np.asarray(got, np.float64)[:F] - want).max() / np.abs(want).max()
+    assert err < 1e-6, err

@@ -131,7 +131,8 @@ def _train(x, y, params, n_iter=8):
 
 
 @pytest.mark.parametrize("use_fused", [True, False])
-def test_partitioned_matches_masked_trees(rng, use_fused):
+def test_partitioned_matches_masked_trees(use_fused):
+    rng = np.random.RandomState(42)
     n, f = 3000, 9
     x = rng.rand(n, f).astype(np.float32)
     logit = 3.0 * x[:, 0] - 2.0 * x[:, 1] + x[:, 2] * x[:, 3]
@@ -156,10 +157,11 @@ def test_partitioned_matches_masked_trees(rng, use_fused):
     np.testing.assert_allclose(pm, pp, rtol=1e-4, atol=1e-5)
 
 
-def test_partitioned_multiclass_fused_matches_masked(rng):
+def test_partitioned_multiclass_fused_matches_masked():
     """Multiclass fused training scans the class axis under the
     partitioned builder (vmap would run every lax.switch branch);
     trees must match the masked builder's vmap path."""
+    rng = np.random.RandomState(42)
     n, f, k = 2400, 6, 3
     x = rng.rand(n, f).astype(np.float32)
     y = (x[:, 0] * 3 + x[:, 1] * 2).astype(np.int32) % k
@@ -179,7 +181,8 @@ def test_partitioned_multiclass_fused_matches_masked(rng):
                                rtol=1e-4, atol=1e-5)
 
 
-def test_partitioned_binary_quality(rng):
+def test_partitioned_binary_quality():
+    rng = np.random.RandomState(42)
     # n > 2 chunks so the end-to-end builder exercises the multi-chunk
     # windows of both segment_histograms and _partition_segment
     n, f = 9000, 12
