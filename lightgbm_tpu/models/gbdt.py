@@ -268,11 +268,24 @@ class GBDT:
     # (gbdt.cpp:210-245) keeps everything in-process; this keeps
     # everything in-graph.
 
+    def _fused_boosting_ok(self):
+        """Whether this boosting type's per-iteration logic is pure
+        in-graph work. DART's tree dropping mutates the model list on
+        host; GOSS overrides this (its sampling runs in-graph via
+        _fused_inbag_fn)."""
+        return type(self).__name__ == "GBDT"
+
+    def _fused_inbag_fn(self):
+        """Optional (iter, grad, hess) -> (N_pad,) in-bag weights hook
+        for the fused scan (grad/hess are (K, N_pad) padded); None =
+        constant all-ones. The caller masks padding rows afterwards."""
+        return None
+
     def _fused_eligible(self):
         cfg = self.config
         if cfg is None or self.objective is None:
             return False
-        return (type(self).__name__ == "GBDT"
+        return (self._fused_boosting_ok()
                 and not self.valid_score_updaters
                 and (cfg.metric_freq <= 0 or not self.training_metrics)
                 and self.early_stopping_round <= 0
@@ -308,20 +321,24 @@ class GBDT:
 
         num_class = self.num_class
         use_partitioned = getattr(learner, "_use_partitioned", False)
+        inbag_fn = self._fused_inbag_fn()
 
-        def step(score, fmask):
+        def step(score, xs):
+            fmask, it = xs
             g, h = grad_fn(score)
             gp = jnp.pad(g, ((0, 0), (0, pad)))
             hp = jnp.pad(h, ((0, 0), (0, pad)))
+            # per-iteration in-bag weights (GOSS); pad rows stay zero
+            ib = inbag if inbag_fn is None else inbag_fn(it, gp, hp) * inbag
             if num_class == 1:
-                out = core(bins, gp[0], hp[0], inbag, fmask, nbpf, iscat)
+                out = core(bins, gp[0], hp[0], ib, fmask, nbpf, iscat)
                 upd = jnp.take(out["leaf_value"], out["row_leaf"][:n])[None, :]
             elif not use_partitioned:
                 # one device program for ALL classes: vmap the whole-tree
                 # builder over the class axis (SURVEY M2; the reference
                 # loops classes serially, gbdt.cpp:210-245)
                 out = jax.vmap(
-                    lambda gg, hh: core(bins, gg, hh, inbag, fmask,
+                    lambda gg, hh: core(bins, gg, hh, ib, fmask,
                                         nbpf, iscat))(gp, hp)
                 upd = jax.vmap(
                     lambda lv, rl: jnp.take(lv, rl[:n]))(
@@ -334,7 +351,7 @@ class GBDT:
                 # the reference's sequential class loop)
                 def class_step(_, gh):
                     gg, hh = gh
-                    o = core(bins, gg, hh, inbag, fmask, nbpf, iscat)
+                    o = core(bins, gg, hh, ib, fmask, nbpf, iscat)
                     u = jnp.take(o["leaf_value"], o["row_leaf"][:n])
                     return None, (o, u)
 
@@ -343,12 +360,13 @@ class GBDT:
             del out["row_leaf"]  # keep the stacked ys O(iter * num_leaves)
             return score, out
 
-        def fused(score, fmasks):
-            return jax.lax.scan(step, score, fmasks)
+        def fused(score, fmasks, iters):
+            return jax.lax.scan(step, score, (fmasks, iters))
 
         score = self.train_score_updater.score
         fmasks = jnp.ones((num_iters, learner.f_pad), dtype=bool)
-        compiled = jax.jit(fused).lower(score, fmasks).compile()
+        iters = jnp.arange(num_iters, dtype=jnp.int32)
+        compiled = jax.jit(fused).lower(score, fmasks, iters).compile()
         self._fused_cache[key] = compiled
         return compiled
 
@@ -375,7 +393,9 @@ class GBDT:
         learner = self.tree_learner
         fmasks = jnp.asarray(
             np.stack([learner._sample_features() for _ in range(num_iters)]))
-        final_score, stacked = fn(self.train_score_updater.score, fmasks)
+        iters = jnp.arange(self.iter, self.iter + num_iters, dtype=jnp.int32)
+        final_score, stacked = fn(self.train_score_updater.score, fmasks,
+                                  iters)
         self.train_score_updater.score = final_score
         host = jax.device_get(stacked)  # ONE transfer for the whole block
         nsp = np.asarray(host["n_splits"]).reshape(num_iters, -1)  # (T, K)
@@ -404,18 +424,25 @@ class GBDT:
         if t_eff < num_iters:
             Log.info("Stopped training because there are no more leafs "
                      "that meet the split requirements.")
-            if self.num_class == 1:
+            if self.num_class == 1 and self._fused_inbag_fn() is None:
                 # iterations after the first empty tree changed nothing
-                # (empty trees add zero score): state is already exact
+                # (constant in-bag weights: unchanged gradients keep the
+                # tree empty, and empty trees add zero score) — state is
+                # already exact
                 return True
-            # multiclass: classes after k_stop (and later iterations)
-            # kept learning inside the scan — rebuild scores from the
-            # kept trees so booster state matches the model list
+            # multiclass (classes after k_stop kept learning) or
+            # per-iteration sampling (a later sample can split again):
+            # the scan's score includes discarded trees — rebuild from
+            # the kept trees so booster state matches the model list
             self.train_score_updater = ScoreUpdater(self.train_data,
                                                     self.num_class)
-            for i, tree in enumerate(self.models):
+            # skip merged/loaded init trees: the fresh updater's init
+            # score already covers them (reset_training_data replays the
+            # same range)
+            first = self.num_init_iteration * self.num_class
+            for idx in range(first, len(self.models)):
                 self.train_score_updater.add_score_by_tree(
-                    tree, i % self.num_class)
+                    self.models[idx], idx % self.num_class)
             return True
         return False
 

@@ -15,8 +15,15 @@ score updates through the full-row partition.
 Row score = sum over classes of |g * h| with a plain-boosting warm-up
 of ceil(1 / learning_rate) iterations, both per the paper's reference
 implementation.
+
+The sampling runs entirely in-graph (sort threshold + jax PRNG keyed on
+(bagging_seed, iteration)), so GOSS keeps the fused multi-iteration
+trainer (models/gbdt.py train_many) — the per-iteration loop calls the
+SAME device function, making the two paths produce identical samples.
 """
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..utils.log import Log
@@ -38,28 +45,54 @@ class GOSS(GBDT):
             Log.fatal("Cannot use bagging in GOSS (bagging_fraction/"
                       "bagging_freq conflict with gradient-based sampling)")
         self._warmup = int(np.ceil(1.0 / max(config.learning_rate, 1e-6)))
+        self._goss_key = jax.random.PRNGKey(config.bagging_seed)
 
-    def _bagging(self, it, gradients=None, hessians=None):
+    def _goss_weights(self, it, gradients, hessians):
+        """(K, M) device grads -> (M,) in-bag weights, in-graph.
+
+        M may include zero-gradient padding rows: they sort to the
+        bottom, and the caller masks any sampled pad rows away (the
+        fused path multiplies by the pad mask; the per-iteration path
+        slices to N).
+        """
         cfg = self.config
-        if it < self._warmup or gradients is None:
-            return None
         n = self.num_data
-        g = np.abs(np.asarray(gradients, dtype=np.float64)
-                   * np.asarray(hessians, dtype=np.float64))
-        score = g.reshape(self.num_class, n).sum(axis=0)
+        m = gradients.shape[-1]
+        score = jnp.sum(jnp.abs(gradients * hessians), axis=0)
         top_n = max(1, int(cfg.top_rate * n))
         rand_n = int(cfg.other_rate * n)
-        # threshold of the top_n-th largest score (ties land in the top set)
-        thr = np.partition(score, n - top_n)[n - top_n]
+        thr = jnp.sort(score)[m - top_n]  # ties land in the top set
         top = score >= thr
-        rest = ~top
-        n_rest = int(rest.sum())
-        mask = np.zeros(n, dtype=np.float32)
-        mask[top] = 1.0
-        if rand_n > 0 and n_rest > 0:
+        weights = top.astype(jnp.float32)
+        if rand_n > 0:
             amp = (1.0 - cfg.top_rate) / cfg.other_rate
-            u = self.random._rng.random_sample(n)
-            mask[rest & (u < rand_n / n_rest)] = amp
-        Log.debug("GOSS: %d top + ~%d sampled rows of %d",
-                  int(top.sum()), rand_n, n)
-        return mask
+            p = rand_n / max(n - top_n, 1)
+            # draw at the UNPADDED size: jax.random.uniform values depend
+            # on the array size, and the fused path passes padded rows —
+            # a (m,) draw would make fused and sequential samples diverge
+            u = jax.random.uniform(
+                jax.random.fold_in(self._goss_key, it), (n,))
+            if m > n:  # pad rows: u=1 >= p, never sampled
+                u = jnp.pad(u, (0, m - n), constant_values=1.0)
+            weights = jnp.where(~top & (u < p), jnp.float32(amp), weights)
+        # warm-up iterations train on all rows
+        return jnp.where(it < self._warmup, jnp.ones(m, jnp.float32),
+                         weights)
+
+    def _fused_boosting_ok(self):
+        return True  # sampling is in-graph; the fused scan stays valid
+
+    def _fused_inbag_fn(self):
+        return self._goss_weights
+
+    def _bagging(self, it, gradients=None, hessians=None):
+        if gradients is None:
+            return None
+        if it < self._warmup:
+            return None
+        w = self._goss_weights(
+            jnp.int32(it),
+            jnp.asarray(gradients, jnp.float32).reshape(self.num_class, -1),
+            jnp.asarray(hessians, jnp.float32).reshape(self.num_class, -1))
+        Log.debug("GOSS: re-sampled at iteration %d", it)
+        return np.asarray(w)[:self.num_data]

@@ -260,6 +260,27 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
                       "set is_enable_sparse=false")
         super().init(train_set)
 
+    # replicate the split-column bin copy only below this size; larger
+    # datasets keep the owner-broadcast psum (memory >> one allreduce
+    # of (N,) int32 per split)
+    REPLICATED_BINS_MAX_BYTES = 1 << 30
+
+    def _place_bins(self, bins):
+        # the reference stores ALL data on every machine in feature-
+        # parallel mode (feature_parallel_tree_learner.cpp); when that
+        # fits, keep a replicated copy for split-column reads so applying
+        # a split needs no collective
+        if bins.nbytes > self.REPLICATED_BINS_MAX_BYTES:
+            self._bins_replicated = None
+            return super()._place_bins(bins)
+        rep = NamedSharding(self.mesh, P())
+        if self.n_proc > 1:
+            from .distributed import place_replicated
+            self._bins_replicated = place_replicated(rep, bins)
+        else:
+            self._bins_replicated = jax.device_put(bins, rep)
+        return super()._place_bins(bins)
+
     def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
         max_bin = self.max_bin
@@ -267,8 +288,10 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
         max_depth = int(cfg.max_depth)
         f_loc = self.f_pad // self.n_shards
 
+        replicated = self._bins_replicated is not None
+
         def fp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
-                  is_cat_full):
+                  is_cat_full, bins_full):
             shard = jax.lax.axis_index(AXIS)
 
             def sum_bcast(s):
@@ -292,11 +315,17 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
                 return jax.tree_util.tree_map(lambda x: x[widx], gathered)
 
             def split_col(feat):
+                # the reference stores ALL data per machine in feature-
+                # parallel mode; when the replicated copy fits (see
+                # _place_bins), the split column is a direct read and
+                # applying a split needs no collective. Otherwise fall
+                # back to broadcasting the owner shard's column.
+                if replicated:
+                    return jnp.take(bins_full, feat, axis=0).astype(jnp.int32)
                 lo = shard * f_loc
                 owned = (feat >= lo) & (feat < lo + f_loc)
                 local_feat = jnp.clip(feat - lo, 0, f_loc - 1)
                 col = jnp.take(bins, local_feat, axis=0).astype(jnp.int32)
-                # broadcast the owner's column (zero elsewhere)
                 return jax.lax.psum(jnp.where(owned, col, 0), AXIS)
 
             return build_tree_device(
@@ -310,10 +339,14 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
             inner = jax.shard_map(
                 fp_fn, mesh=self.mesh,
                 in_specs=(P(AXIS, None), P(None), P(None), P(None),
-                          P(AXIS), P(AXIS), P(AXIS), P(None)),
+                          P(AXIS), P(AXIS), P(AXIS), P(None), P(None)),
                 out_specs=self._out_specs(), check_vma=False)
+            # dummy stand-in when the replicated copy was too large: the
+            # traced split_col never reads it
+            bins_full = (self._bins_replicated if replicated
+                         else jnp.zeros((1, 1), bins.dtype))
             return inner(bins, grad, hess, inbag, fmask, num_bin_pf,
-                         is_cat, is_cat)
+                         is_cat, is_cat, bins_full)
 
         return wrapped7
 

@@ -81,3 +81,34 @@ def test_goss_model_roundtrip(tmp_path):
     assert type(b2).__name__ == "GOSS"
     b2.load_model_from_string(open(path).read())
     np.testing.assert_allclose(b.predict(x), b2.predict(x), rtol=1e-12)
+
+
+def test_goss_fused_matches_sequential():
+    """GOSS's in-graph sampling keys on (bagging_seed, iteration), so the
+    fused scan and the per-iteration loop draw identical samples and
+    grow identical trees."""
+    rng = np.random.RandomState(7)
+    n, f = 3000, 8
+    x = rng.rand(n, f).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 1.0).astype(np.float32)
+    params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+              "learning_rate": 0.3, "metric_freq": 0, "min_data_in_leaf": 20}
+    n_iter = 8  # warm-up = ceil(1/0.3) = 4, so 4 sampled iterations
+
+    b_seq = _train(x, y, params, n_iter)
+
+    cfg = Config.from_params(params)
+    ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    objective = create_objective(cfg.objective, cfg)
+    objective.init(ds.metadata, ds.num_data)
+    b_fused = create_boosting(cfg.boosting_type)
+    b_fused.init(cfg, ds, objective, [])
+    assert b_fused.warm_up_fused(n_iter), "GOSS should be fused-eligible"
+    b_fused.train_many(n_iter)
+
+    assert len(b_seq.models) == len(b_fused.models) == n_iter
+    for ts, tf in zip(b_seq.models, b_fused.models):
+        np.testing.assert_array_equal(ts.split_feature, tf.split_feature)
+        np.testing.assert_array_equal(ts.threshold_in_bin, tf.threshold_in_bin)
+        np.testing.assert_allclose(ts.leaf_value, tf.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
