@@ -2,6 +2,7 @@
 structure and accuracy parity with full-data GBDT."""
 
 import numpy as np
+import pytest
 
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.dataset import DatasetLoader
@@ -83,16 +84,20 @@ def test_goss_model_roundtrip(tmp_path):
     np.testing.assert_allclose(b.predict(x), b2.predict(x), rtol=1e-12)
 
 
-def test_goss_fused_matches_sequential():
+
+@pytest.mark.parametrize("partitioned", ["false", "true"])
+def test_goss_fused_matches_sequential(partitioned):
     """GOSS's in-graph sampling keys on (bagging_seed, iteration), so the
     fused scan and the per-iteration loop draw identical samples and
-    grow identical trees."""
+    grow identical trees — under both builders (partitioned is what a
+    TPU user gets by default with boosting=goss)."""
     rng = np.random.RandomState(7)
     n, f = 3000, 8
     x = rng.rand(n, f).astype(np.float32)
     y = (x[:, 0] + x[:, 1] > 1.0).astype(np.float32)
     params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
-              "learning_rate": 0.3, "metric_freq": 0, "min_data_in_leaf": 20}
+              "learning_rate": 0.3, "metric_freq": 0, "min_data_in_leaf": 20,
+              "partitioned_build": partitioned}
     n_iter = 8  # warm-up = ceil(1/0.3) = 4, so 4 sampled iterations
 
     b_seq = _train(x, y, params, n_iter)
