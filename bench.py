@@ -12,7 +12,8 @@ Robustness contract (BENCH_r01 died at backend init, BENCH_r02 lost a
 measured result to a driver timeout):
 - the TPU-tunnel backend is probed in a subprocess with a hard timeout;
 - EVERY measurement runs in a subprocess with its own timeout, with a
-  fallback ladder: TPU pallas kernel -> TPU XLA path
+  fallback ladder: TPU partitioned builder -> TPU masked builder
+  (BENCH_NO_PARTITIONED=1) -> TPU XLA path
   (LIGHTGBM_TPU_DISABLE_PALLAS=1) -> CPU;
 - the primary 1M result line is printed and FLUSHED the moment it
   exists; the optional HIGGS (11M) attempt can only ADD a richer final
@@ -104,8 +105,10 @@ def train_once(n_rows):
         "metric_freq": 0,  # no eval inside the timed loop
         # leaf-contiguous builder on every backend (auto = TPU only):
         # histogram cost scales with leaf size, ~20x less streaming at
-        # 63 leaves (models/partitioned.py)
-        "partitioned_build": "true",
+        # 63 leaves (models/partitioned.py); BENCH_NO_PARTITIONED is the
+        # fallback-ladder escape hatch
+        "partitioned_build": ("false" if os.environ.get("BENCH_NO_PARTITIONED")
+                              else "true"),
     })
 
     _mark(f"generating {n_rows} rows")
@@ -180,7 +183,8 @@ def run_child():
          "platform": jax.devices()[0].platform}), flush=True)
 
 
-def measure(n_rows, timeout_s, force_cpu=False, disable_pallas=False):
+def measure(n_rows, timeout_s, force_cpu=False, disable_pallas=False,
+            no_partitioned=False):
     """Run one measurement in a subprocess. Returns (dict|None, note)."""
     env = dict(os.environ)
     env["BENCH_CHILD_ROWS"] = str(n_rows)
@@ -192,6 +196,8 @@ def measure(n_rows, timeout_s, force_cpu=False, disable_pallas=False):
         env["BENCH_CHILD_CPU"] = "1"
     if disable_pallas:
         env["LIGHTGBM_TPU_DISABLE_PALLAS"] = "1"
+    if no_partitioned:
+        env["BENCH_NO_PARTITIONED"] = "1"
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"],
@@ -206,11 +212,13 @@ def measure(n_rows, timeout_s, force_cpu=False, disable_pallas=False):
 
 
 def measure_with_fallback(n_rows, timeout_s, on_cpu_backend, start_at=None):
-    """TPU pallas -> TPU XLA -> CPU ladder. `start_at` skips rungs a
-    previous measurement already proved dead."""
+    """tpu-part -> tpu-masked -> tpu-xla -> cpu ladder (see module
+    docstring). `start_at` skips rungs a previous measurement already
+    proved dead (value = a rung name from this list)."""
     attempts = ([("cpu", dict(force_cpu=True))] if on_cpu_backend else
-                [("tpu-pallas", {}),
-                 ("tpu-xla", dict(disable_pallas=True)),
+                [("tpu-part", {}),
+                 ("tpu-masked", dict(no_partitioned=True)),
+                 ("tpu-xla", dict(disable_pallas=True, no_partitioned=True)),
                  ("cpu", dict(force_cpu=True))])
     if start_at is not None:
         names = [n for n, _ in attempts]
@@ -237,8 +245,12 @@ def main():
     on_cpu = platform == "cpu"
 
     res = measure_with_fallback(N_ROWS, PRIMARY_TIMEOUT_S, on_cpu)
+    metric_name = ("train_time_1Mx28_binary_100iter_63leaves"
+                   if N_ROWS == 1_000_000 and NUM_ITERATIONS == 100
+                   else f"train_time_{N_ROWS}x28_binary_"
+                        f"{NUM_ITERATIONS}iter_63leaves")
     result = {
-        "metric": "train_time_1Mx28_binary_100iter_63leaves",
+        "metric": metric_name,
         "value": res.get("time_s", -1),
         "unit": "s",
         "vs_baseline": (round(REF_TRAIN_SECONDS / res["time_s"], 3)

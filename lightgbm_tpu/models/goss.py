@@ -65,8 +65,15 @@ class GOSS(GBDT):
         top = score >= thr
         weights = top.astype(jnp.float32)
         if rand_n > 0:
-            amp = (1.0 - cfg.top_rate) / cfg.other_rate
-            p = rand_n / max(n - top_n, 1)
+            # realized rest size over REAL rows (ties at the threshold
+            # inflate the top set); p and amp both use it so that
+            # E[#sampled] = rand_n AND p * amp = 1 (each rest row keeps
+            # expected weight 1 — the paper's unbiased-gain invariant).
+            # Without ties n_rest = n - top_n and amp reduces to the
+            # paper's (1 - top_rate) / other_rate.
+            n_rest = jnp.maximum(jnp.sum((~top[:n]).astype(jnp.int32)), 1)
+            p = rand_n / n_rest
+            amp = (n_rest / rand_n).astype(jnp.float32)
             # draw at the UNPADDED size: jax.random.uniform values depend
             # on the array size, and the fused path passes padded rows —
             # a (m,) draw would make fused and sequential samples diverge

@@ -165,8 +165,15 @@ def segment_histograms(words, ghc_t, begin, cnt, num_bins_total, f,
     cnt = jnp.maximum(cnt, 0).astype(jnp.int32)
     idx, c_first = cover_index(begin, cnt, n_chunks)
 
-    on_tpu = (jax.default_backend() == "tpu"
-              if interpret_backend is None else interpret_backend == "tpu")
+    import os
+    if interpret_backend is None:
+        # same escape hatch as ops/pallas_hist.py masked_histograms:
+        # force the XLA path on TPU if the kernel regresses (bench.py
+        # fallback ladder); an explicit interpret_backend wins
+        on_tpu = (jax.default_backend() == "tpu"
+                  and not os.environ.get("LIGHTGBM_TPU_DISABLE_PALLAS"))
+    else:
+        on_tpu = interpret_backend == "tpu"
 
     def make_branch(bk):
         def branch(begin, cnt):
