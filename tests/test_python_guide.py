@@ -1,0 +1,56 @@
+"""Run the reference's OWN python-guide example scripts against this
+package (examples/python-guide/*.py, the reference's user-facing API
+demonstration): `import lightgbm` is aliased to lightgbm_tpu and the
+scripts execute verbatim from their own directory. This is the
+strongest end-user compatibility check — a user's script written for
+the reference runs unchanged."""
+
+import os
+import runpy
+import shutil
+import sys
+
+import pytest
+
+GUIDE = "/root/reference/examples/python-guide"
+
+
+def _run_guide_script(name, tmp_path, monkeypatch):
+    import lightgbm_tpu
+    monkeypatch.setitem(sys.modules, "lightgbm", lightgbm_tpu)
+    # scripts read ../regression/... and ../binary_classification/...
+    # relative to their directory and write model files to cwd: copy the
+    # script into a scratch layout (NEVER run inside the read-only
+    # reference tree — the scripts write model.txt to cwd) with the data
+    # dirs symlinked for reading
+    run_dir = tmp_path / "python-guide"
+    run_dir.mkdir()
+    shutil.copy(os.path.join(GUIDE, name), run_dir / name)
+    for data_dir in ("regression", "binary_classification"):
+        os.symlink(f"/root/reference/examples/{data_dir}",
+                   tmp_path / data_dir)
+    monkeypatch.chdir(run_dir)
+    runpy.run_path(str(run_dir / name), run_name="__main__")
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_simple_example(tmp_path, monkeypatch):
+    _run_guide_script("simple_example.py", tmp_path, monkeypatch)
+
+
+# ~20 min together on the CPU mesh (GridSearchCV = 9 fits; the advanced
+# script trains 6 boosters): verified passing, but kept out of the
+# default suite. LIGHTGBM_TPU_RUN_SLOW=1 enables them.
+_SLOW = not os.environ.get("LIGHTGBM_TPU_RUN_SLOW")
+
+
+@pytest.mark.skipif(_SLOW, reason="set LIGHTGBM_TPU_RUN_SLOW=1")
+@pytest.mark.filterwarnings("ignore")
+def test_sklearn_example(tmp_path, monkeypatch):
+    _run_guide_script("sklearn_example.py", tmp_path, monkeypatch)
+
+
+@pytest.mark.skipif(_SLOW, reason="set LIGHTGBM_TPU_RUN_SLOW=1")
+@pytest.mark.filterwarnings("ignore")
+def test_advanced_example(tmp_path, monkeypatch):
+    _run_guide_script("advanced_example.py", tmp_path, monkeypatch)
