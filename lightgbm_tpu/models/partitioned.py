@@ -93,10 +93,14 @@ def _partition_segment(words, ghc, perm, seg_b, seg_c, feat, thr, cat):
                           seg_b, seg_c)
 
 
+def _identity(x):
+    return x
+
+
 def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
                            num_bin_pf, is_cat,
                            *, num_leaves, max_bin, params: SplitParams,
-                           max_depth, f_real):
+                           max_depth, f_real, hist_reduce_fn=_identity):
     """Grow one leaf-wise tree on device over the packed-word layout.
 
     Args:
@@ -105,9 +109,20 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
       feature_mask: (F_pad,) bool; num_bin_pf: (F_pad,) int32;
       is_cat: (F_pad,) bool, F_pad == 4 * W.
       num_leaves, max_bin, params, max_depth, f_real: static config.
+      hist_reduce_fn: reduction applied to every segment histogram —
+        `lax.psum` over the row-shard axis for the data-parallel
+        learner (the reference's histogram ReduceScatter sync point,
+        data_parallel_tree_learner.cpp:155-157). Called OUTSIDE the
+        bucketed lax.switch, so every shard executes the collective in
+        lockstep even when their segment buckets differ. Plain f32
+        psum: every shard sees the identical reduced histogram, so all
+        shards take identical splits (cross-shard consistency); unlike
+        the masked builder's Kahan pair_allreduce this does NOT
+        guarantee last-ulp equality with the SERIAL partitioned
+        builder's summation order.
 
     Returns the same output dict as build_tree_device (tree arrays +
-    original-order row->leaf partition).
+    original-order row->leaf partition, local rows under shard_map).
     """
     w, n_pad = words.shape
     l = num_leaves
@@ -125,7 +140,8 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
     ghc0 = jnp.stack([g_in, h_in, inbag], axis=0)  # (3, N_pad)
 
     def leaf_histogram(words_c, ghc_c, begin, cnt):
-        return segment_histograms(words_c, ghc_c, begin, cnt, b, f_pad)
+        return hist_reduce_fn(
+            segment_histograms(words_c, ghc_c, begin, cnt, b, f_pad))
 
     # ---- root ----------------------------------------------------------
     hist_root = leaf_histogram(words, ghc0, jnp.int32(0), jnp.int32(n_pad))

@@ -386,19 +386,24 @@ class SerialTreeLearner:
         Log.info("Number of data: %d, number of features: %d",
                  self.num_data, self.num_features)
 
+    # which learner classes can run the leaf-contiguous builder
+    # (parallel/learners.py sets True on the data-parallel learner)
+    partitioned_capable = True
+
     def _partitioned_enabled(self, cfg):
-        """Leaf-contiguous builder (models/partitioned.py): serial
-        learner only; "auto" turns it on for TPU backends. Needs an
-        unbundled dataset (bundling's expand/decode hooks are only
-        wired into the masked builder) and uint8-storable bins."""
-        if type(self) is not SerialTreeLearner:
-            return False
+        """Leaf-contiguous builder (models/partitioned.py): "auto"
+        turns it on for TPU backends. Needs an unbundled dataset
+        (bundling's expand/decode hooks are only wired into the masked
+        builder) and uint8-storable bins."""
         mode = str(getattr(cfg, "partitioned_build", "auto")).lower()
-        if mode in ("false", "0", "off", "-"):
-            return False
-        if mode not in ("true", "1", "on", "+", "auto"):
+        if mode not in ("true", "1", "on", "+", "auto", "false", "0",
+                        "off", "-"):
             Log.fatal('partitioned_build must be "auto", "true" or '
                       '"false", got [%s]', mode)
+        if not self.partitioned_capable:
+            return False
+        if mode in ("false", "0", "off", "-"):
+            return False
         eligible = (self._bundle is None
                     and int(self.train_set.max_stored_bin) <= 256)
         if mode in ("true", "1", "on", "+"):

@@ -110,6 +110,9 @@ class _MeshedTreeLearner(SerialTreeLearner):
     # which input axes are sharded: "rows" or "features"
     shard_rows = True
     shard_features = False
+    # only the data-parallel learner re-enables the leaf-contiguous
+    # builder (per-shard layouts + one psum per histogram)
+    partitioned_capable = False
 
     def init(self, train_set):
         self.mesh = make_mesh(self.config)
@@ -134,7 +137,7 @@ class _MeshedTreeLearner(SerialTreeLearner):
         n_max = self.local_rows_max or -(-self.global_num_data // self.n_proc)
         n_max = max(n_max, n)  # never pad below the local row count
         shard = -(-n_max // d_local)
-        if jax.default_backend() == "tpu":
+        if jax.default_backend() == "tpu" or self._use_partitioned:
             from ..ops.pallas_hist import HIST_CHUNK
             shard = ((shard + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
         elif shard > chunk:
@@ -144,7 +147,7 @@ class _MeshedTreeLearner(SerialTreeLearner):
     def _effective_chunk(self, chunk):
         if not self.shard_rows:
             return super()._effective_chunk(chunk)
-        if jax.default_backend() == "tpu":
+        if jax.default_backend() == "tpu" or self._use_partitioned:
             from ..ops.pallas_hist import HIST_CHUNK
             return min(chunk, HIST_CHUNK)
         # the scan chunk must divide the LOCAL shard length so the
@@ -154,7 +157,7 @@ class _MeshedTreeLearner(SerialTreeLearner):
 
     def _pad_feature_count(self, f):
         if not self.shard_features:
-            return f
+            return super()._pad_feature_count(f)  # ceil-4 when partitioned
         k = self.n_shards
         return ((f + k - 1) // k) * k
 
@@ -169,6 +172,9 @@ class _MeshedTreeLearner(SerialTreeLearner):
         return NamedSharding(self.mesh, P())  # replicated
 
     def _place_bins(self, bins):
+        if self._use_partitioned:
+            from ..ops.ordered_hist import pack_feature_words
+            bins = pack_feature_words(bins)  # (W, N): same row sharding
         sh = self._bins_sharding()
         if self.n_proc > 1:
             from .distributed import place_global_rows, place_replicated
@@ -217,15 +223,57 @@ class _MeshedTreeLearner(SerialTreeLearner):
 
 
 class DataParallelTreeLearner(_MeshedTreeLearner):
-    """Row-sharded learner (data_parallel_tree_learner.cpp)."""
+    """Row-sharded learner (data_parallel_tree_learner.cpp).
+
+    Two cores: the masked builder with deterministic Kahan
+    pair-allreduce (default — including partitioned_build=auto — grows
+    trees IDENTICAL to the serial masked learner, the reference's
+    structural guarantee), and the partitioned builder (EXPLICIT
+    partitioned_build=true only) where each shard keeps its own
+    leaf-contiguous layout and every segment histogram is one f32 psum
+    — the fast path whose trees match the serial partitioned learner up
+    to f32 summation-order ulps."""
     name = "data"
     shard_rows = True
+    partitioned_capable = True
+
+    def _partitioned_enabled(self, cfg):
+        # EXPLICIT opt-in only ("auto" keeps masked + Kahan
+        # pair-allreduce): the default must preserve the reference's
+        # exact serial == data-parallel tree guarantee
+        mode = str(getattr(cfg, "partitioned_build", "auto")).lower()
+        if mode not in ("true", "1", "on", "+", "auto", "false", "0",
+                        "off", "-"):
+            Log.fatal('partitioned_build must be "auto", "true" or '
+                      '"false", got [%s]', mode)
+        if mode not in ("true", "1", "on", "+"):
+            return False
+        return super()._partitioned_enabled(cfg)
 
     def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
         max_bin = self.max_bin
         params = self.params
         max_depth = int(cfg.max_depth)
+
+        if self._use_partitioned:
+            from ..models.partitioned import build_tree_partitioned
+            f_real = self.num_features
+            psum = functools.partial(jax.lax.psum, axis_name=AXIS)
+
+            def dp_part_fn(words, grad, hess, inbag, fmask, num_bin_pf,
+                           is_cat):
+                return build_tree_partitioned(
+                    words, grad, hess, inbag, fmask, num_bin_pf, is_cat,
+                    num_leaves=num_leaves, max_bin=max_bin, params=params,
+                    max_depth=max_depth, f_real=f_real,
+                    hist_reduce_fn=psum)
+
+            return jax.shard_map(
+                dp_part_fn, mesh=self.mesh,
+                in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
+                          P(None), P(None), P(None)),
+                out_specs=self._out_specs(), check_vma=False)
 
         def dp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
             # hist pair-allreduce already yields the GLOBAL histogram on

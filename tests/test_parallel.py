@@ -97,3 +97,45 @@ def test_data_parallel_with_bagging(data):
     g = _train(cfg, X, y, rounds=15)
     p = g.predict(X)[:, 0]
     assert np.mean((p > 0.5) != y) < 0.05
+
+
+def test_data_parallel_partitioned_matches_serial_partitioned():
+    """Opt-in partitioned data-parallel (per-shard leaf-contiguous
+    layouts + one psum per segment histogram) grows the serial
+    partitioned learner's trees; plain-f32 psum can ulp-diverge only on
+    gain ties, which this well-separated data avoids."""
+    rng = np.random.RandomState(3)
+    n, f = 4000, 8
+    X = rng.rand(n, f).astype(np.float32)
+    y = (2.0 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+         + 0.05 * rng.randn(n) > 0.7).astype(np.float32)
+
+    def cfg(learner):
+        return Config.from_params({
+            "objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+            "tree_learner": learner, "verbose": -1, "metric_freq": 0,
+            "partitioned_build": "true"})
+
+    g_serial = _train(cfg("serial"), X, y, rounds=5)
+    g_dp = _train(cfg("data"), X, y, rounds=5)
+    assert g_serial.tree_learner._use_partitioned
+    assert g_dp.tree_learner._use_partitioned
+    assert len(g_serial.models) == len(g_dp.models)
+    for ts, td in zip(g_serial.models, g_dp.models):
+        np.testing.assert_array_equal(ts.split_feature, td.split_feature)
+        np.testing.assert_array_equal(ts.threshold_in_bin, td.threshold_in_bin)
+        np.testing.assert_allclose(ts.leaf_value, td.leaf_value,
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_data_parallel_auto_keeps_masked():
+    """partitioned_build=auto must NOT switch the data-parallel learner
+    off the exact masked + Kahan path (the serial == DP guarantee)."""
+    rng = np.random.RandomState(4)
+    X = rng.rand(600, 5).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 7, "tree_learner": "data",
+        "verbose": -1, "metric_freq": 0})
+    g = _train(cfg, X, y, rounds=2)
+    assert not g.tree_learner._use_partitioned
