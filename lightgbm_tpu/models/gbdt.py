@@ -20,7 +20,6 @@ import numpy as np
 from ..metrics import create_metric
 from ..utils import common
 from ..utils.log import Log
-from ..utils.random import Random
 from ..utils.timers import TIMERS
 from .score_updater import ScoreUpdater
 from .tree import Tree
@@ -97,14 +96,13 @@ class GBDT:
         self.best_iter = []
         self.best_score = []
         self.best_msg = []
-        self.random = Random(3)
         self._bag_rows = None       # in-bag float mask or None
+        self._bag_window = None     # it // bagging_freq of the cached bag
 
     # ------------------------------------------------------------------ init
     def init(self, config, train_data, objective, training_metrics=()):
         self.iter = 0
         self.num_class = config.num_class
-        self.random = Random(config.bagging_seed)
         self.config = None
         self.train_data = None
         self.reset_training_data(config, train_data, objective, training_metrics)
@@ -117,6 +115,9 @@ class GBDT:
         self.early_stopping_round = config.early_stopping_round
         self.shrinkage_rate = config.learning_rate
         self.objective = objective
+        self._bag_fn = None   # bakes in config/metadata; rebuild lazily
+        self._bag_rows = None
+        self._bag_window = None
         self.sigmoid = -1.0
         if objective is not None and objective.name == "binary":
             self.sigmoid = config.sigmoid
@@ -169,33 +170,69 @@ class GBDT:
             self.best_msg.append([""] * len(valid_metrics))
 
     # --------------------------------------------------------------- bagging
+    def _bagging_device_fn(self):
+        """(iter, grad, hess) -> (M,) in-bag mask, fully in-graph —
+        record- or query-unit bagging (gbdt.cpp:150-201) with an exact
+        bag count via jax.random.permutation, keyed on
+        (bagging_seed, iter // bagging_freq) so re-bagging happens at
+        the reference's cadence and the fused scan and per-iteration
+        loop draw identical bags. Returns None when bagging is off."""
+        cfg = self.config
+        if not (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0):
+            return None
+        if getattr(self, "_bag_fn", None) is not None:
+            return self._bag_fn
+        n = self.num_data
+        meta = self.train_data.metadata
+        key = jax.random.PRNGKey(cfg.bagging_seed)
+        freq = int(cfg.bagging_freq)
+        qb = meta.query_boundaries
+        if qb is None:
+            bag_cnt = int(cfg.bagging_fraction * n)
+
+            def fn(it, gradients=None, hessians=None):
+                k = jax.random.fold_in(key, it // freq)
+                mask = (jax.random.permutation(k, n) < bag_cnt)
+                mask = mask.astype(jnp.float32)
+                m = None if gradients is None else gradients.shape[-1]
+                if m is not None and m > n:
+                    mask = jnp.pad(mask, (0, m - n))
+                return mask
+        else:
+            nq = len(qb) - 1
+            bag_q = int(nq * cfg.bagging_fraction)
+            row_q = np.searchsorted(np.asarray(qb), np.arange(n),
+                                    side="right") - 1
+            row_q_dev = jnp.asarray(row_q, jnp.int32)
+
+            def fn(it, gradients=None, hessians=None):
+                k = jax.random.fold_in(key, it // freq)
+                qmask = (jax.random.permutation(k, nq) < bag_q)
+                mask = jnp.take(qmask.astype(jnp.float32), row_q_dev)
+                m = None if gradients is None else gradients.shape[-1]
+                if m is not None and m > n:
+                    mask = jnp.pad(mask, (0, m - n))
+                return mask
+
+        self._bag_fn = fn
+        return fn
+
     def _bagging(self, it, gradients=None, hessians=None):
         """gbdt.cpp:150-201; returns in-bag float mask or None.
         gradients/hessians are provided for gradient-based sampling
         strategies (models/goss.py); plain bagging ignores them."""
-        cfg = self.config
-        if not (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0):
+        fn = self._bagging_device_fn()
+        if fn is None:
             return None
-        if it % cfg.bagging_freq != 0 and self._bag_rows is not None:
+        # cache keyed by the re-bag window (fused blocks and rollbacks
+        # can move self.iter across windows between sequential calls)
+        window = it // self.config.bagging_freq
+        if window == self._bag_window and self._bag_rows is not None:
             return self._bag_rows
-        n = self.num_data
-        meta = self.train_data.metadata
-        mask = np.zeros(n, dtype=np.float32)
-        if meta.query_boundaries is None:
-            bag_cnt = int(cfg.bagging_fraction * n)
-            keys = self.random._rng.random_sample(n)
-            idx = np.argpartition(keys, bag_cnt)[:bag_cnt] if bag_cnt < n else np.arange(n)
-            mask[idx] = 1.0
-        else:
-            qb = meta.query_boundaries
-            nq = len(qb) - 1
-            bag_q = int(nq * cfg.bagging_fraction)
-            keys = self.random._rng.random_sample(nq)
-            qidx = np.argpartition(keys, bag_q)[:bag_q] if bag_q < nq else np.arange(nq)
-            for q in qidx:
-                mask[qb[q]:qb[q + 1]] = 1.0
+        mask = np.asarray(fn(jnp.int32(it)))[:self.num_data]
         Log.debug("Re-bagging, using %d data to train", int(mask.sum()))
         self._bag_rows = mask
+        self._bag_window = window
         return mask
 
     # -------------------------------------------------------------- training
@@ -278,8 +315,9 @@ class GBDT:
     def _fused_inbag_fn(self):
         """Optional (iter, grad, hess) -> (N_pad,) in-bag weights hook
         for the fused scan (grad/hess are (K, N_pad) padded); None =
-        constant all-ones. The caller masks padding rows afterwards."""
-        return None
+        constant all-ones. The caller masks padding rows afterwards.
+        Plain bagging fuses via its in-graph mask; GOSS overrides."""
+        return self._bagging_device_fn()
 
     def _fused_eligible(self):
         cfg = self.config
@@ -289,12 +327,6 @@ class GBDT:
                 and not self.valid_score_updaters
                 and (cfg.metric_freq <= 0 or not self.training_metrics)
                 and self.early_stopping_round <= 0
-                and not (cfg.bagging_fraction < 1.0 and cfg.bagging_freq > 0)
-                # with a constant feature mask, gradients after an empty
-                # tree are unchanged, so every later tree is empty too and
-                # the post-scan truncation in train_many is exact; a
-                # per-iteration mask would break that invariant
-                and cfg.feature_fraction >= 1.0
                 and getattr(self.objective, "_grad", None) is not None
                 and type(self.tree_learner).__name__ == "SerialTreeLearner")
 
@@ -424,16 +456,18 @@ class GBDT:
         if t_eff < num_iters:
             Log.info("Stopped training because there are no more leafs "
                      "that meet the split requirements.")
-            if self.num_class == 1 and self._fused_inbag_fn() is None:
+            if (self.num_class == 1 and self._fused_inbag_fn() is None
+                    and self.config.feature_fraction >= 1.0):
                 # iterations after the first empty tree changed nothing
-                # (constant in-bag weights: unchanged gradients keep the
-                # tree empty, and empty trees add zero score) — state is
-                # already exact
+                # (constant in-bag weights and feature mask: unchanged
+                # gradients keep the tree empty, and empty trees add
+                # zero score) — state is already exact
                 return True
             # multiclass (classes after k_stop kept learning) or
-            # per-iteration sampling (a later sample can split again):
-            # the scan's score includes discarded trees — rebuild from
-            # the kept trees so booster state matches the model list
+            # per-iteration bag/feature sampling (a later sample can
+            # split again): the scan's score includes discarded trees —
+            # rebuild from the kept trees so booster state matches the
+            # model list
             self.train_score_updater = ScoreUpdater(self.train_data,
                                                     self.num_class)
             # skip merged/loaded init trees: the fresh updater's init

@@ -51,6 +51,19 @@ def _identity(x):
     return x
 
 
+def _partitioned_mode(cfg):
+    """Validate + normalize partitioned_build to "auto"/"true"/"false"."""
+    mode = str(getattr(cfg, "partitioned_build", "auto")).lower()
+    if mode in ("true", "1", "on", "+"):
+        return "true"
+    if mode in ("false", "0", "off", "-"):
+        return "false"
+    if mode != "auto":
+        Log.fatal('partitioned_build must be "auto", "true" or '
+                  '"false", got [%s]', mode)
+    return "auto"
+
+
 def init_split_state(l, root_split, root_c):
     """Per-leaf candidate + tree arrays shared by both builders
     (masked build_tree_device and models/partitioned.py)."""
@@ -395,18 +408,18 @@ class SerialTreeLearner:
         turns it on for TPU backends. Needs an unbundled dataset
         (bundling's expand/decode hooks are only wired into the masked
         builder) and uint8-storable bins."""
-        mode = str(getattr(cfg, "partitioned_build", "auto")).lower()
-        if mode not in ("true", "1", "on", "+", "auto", "false", "0",
-                        "off", "-"):
-            Log.fatal('partitioned_build must be "auto", "true" or '
-                      '"false", got [%s]', mode)
+        mode = _partitioned_mode(cfg)
         if not self.partitioned_capable:
+            if mode == "true":
+                Log.warning("partitioned_build=true ignored: the %s "
+                            "learner has no leaf-contiguous core",
+                            getattr(self, "name", "this"))
             return False
-        if mode in ("false", "0", "off", "-"):
+        if mode == "false":
             return False
         eligible = (self._bundle is None
                     and int(self.train_set.max_stored_bin) <= 256)
-        if mode in ("true", "1", "on", "+"):
+        if mode == "true":
             if not eligible:
                 Log.warning("partitioned_build=true ignored: needs an "
                             "unbundled dataset and max_bin <= 256")

@@ -241,12 +241,8 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
         # EXPLICIT opt-in only ("auto" keeps masked + Kahan
         # pair-allreduce): the default must preserve the reference's
         # exact serial == data-parallel tree guarantee
-        mode = str(getattr(cfg, "partitioned_build", "auto")).lower()
-        if mode not in ("true", "1", "on", "+", "auto", "false", "0",
-                        "off", "-"):
-            Log.fatal('partitioned_build must be "auto", "true" or '
-                      '"false", got [%s]', mode)
-        if mode not in ("true", "1", "on", "+"):
+        from ..models.tree_learner import _partitioned_mode
+        if _partitioned_mode(cfg) != "true":
             return False
         return super()._partitioned_enabled(cfg)
 

@@ -10,7 +10,7 @@ from sklearn.model_selection import train_test_split
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.dataset import DatasetLoader
 from lightgbm_tpu.metrics import create_metric
-from lightgbm_tpu.models.gbdt import create_boosting
+from lightgbm_tpu.models.gbdt import GBDT, create_boosting
 from lightgbm_tpu.objectives import create_objective
 
 
@@ -163,3 +163,35 @@ def test_subset_shares_mappers(rng):
     assert sub.num_data == 100
     assert sub.check_align(ds)
     np.testing.assert_array_equal(sub.bins[:, 0], ds.bins[:, 0])
+
+
+def test_bagging_fused_matches_sequential():
+    """In-graph bagging keys on (bagging_seed, iter // bagging_freq), so
+    the fused scan and the per-iteration loop draw identical bags and
+    grow identical trees (the reference's own example confs use bagging,
+    and fusing them is the point of the in-graph mask)."""
+    rng = np.random.RandomState(9)
+    n, f = 3000, 8
+    X = rng.rand(n, f).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    cfg = Config(objective="binary", num_leaves=15, learning_rate=0.1,
+                 bagging_fraction=0.7, bagging_freq=2, min_data_in_leaf=20,
+                 feature_fraction=0.75, verbose=-1, metric_freq=0)
+    n_iter = 6
+
+    g_seq, _ = _train(cfg, X, y, num_rounds=n_iter)
+
+    ds = DatasetLoader(cfg).construct_from_matrix(X, label=y)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    g_fused = GBDT()
+    g_fused.init(cfg, ds, obj, [])
+    assert g_fused.warm_up_fused(n_iter), "bagging should be fused-eligible"
+    g_fused.train_many(n_iter)
+
+    assert len(g_seq.models) == len(g_fused.models) == n_iter
+    for ts, tf in zip(g_seq.models, g_fused.models):
+        np.testing.assert_array_equal(ts.split_feature, tf.split_feature)
+        np.testing.assert_array_equal(ts.threshold_in_bin, tf.threshold_in_bin)
+        np.testing.assert_allclose(ts.leaf_value, tf.leaf_value,
+                                   rtol=1e-4, atol=1e-6)
