@@ -183,6 +183,22 @@ class Application:
                 self.boosting.train_many(cfg.num_iterations)
                 Log.info("%f seconds elapsed, finished iteration %d (fused)",
                          time.time() - start, self.boosting.iter)
+            elif (fused is not None and cfg.metric_freq > 0
+                    and fused(ignore_train_metrics=True)):
+                # training-metric output is the only blocker: run fused
+                # blocks of metric_freq iterations, printing between
+                done = 0
+                while done < cfg.num_iterations:
+                    step = min(cfg.metric_freq, cfg.num_iterations - done)
+                    stopped = self.boosting.train_many(
+                        step, ignore_train_metrics=True)
+                    if self.boosting.iter > done:  # block trained something
+                        done = self.boosting.iter
+                        self.boosting.output_metric(done)
+                        Log.info("%f seconds elapsed, finished iteration %d "
+                                 "(fused block)", time.time() - start, done)
+                    if stopped:
+                        break
             else:
                 for it in range(1, cfg.num_iterations + 1):
                     is_finished = self.boosting.train_one_iter(is_eval=True)

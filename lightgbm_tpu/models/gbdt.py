@@ -8,9 +8,11 @@ load-from-string, split-count feature importance, raw/sigmoid/softmax
 prediction paths, and booster merging for continued training.
 
 Bagging note: the reference draws a sequential selection sample
-(gbdt.cpp:161-169) which is uniform over fixed-size subsets; we draw the
-same distribution with a vectorized random-key argpartition instead of
-the O(N) sequential scan.
+(gbdt.cpp:161-169), uniform over fixed-size subsets. We draw the same
+distribution IN-GRAPH with jax.random.permutation keyed on
+(bagging_seed, iter // bagging_freq): bags are stateless per re-bag
+window, identical between the fused scan and the per-iteration loop,
+and exact-count like the reference's.
 """
 
 import jax
@@ -319,13 +321,17 @@ class GBDT:
         Plain bagging fuses via its in-graph mask; GOSS overrides."""
         return self._bagging_device_fn()
 
-    def _fused_eligible(self):
+    def _fused_eligible(self, ignore_train_metrics=False):
+        """ignore_train_metrics=True answers "could this train fused in
+        metric_freq-sized blocks, with metric output between blocks?"
+        (the CLI uses it, application.py train)."""
         cfg = self.config
         if cfg is None or self.objective is None:
             return False
         return (self._fused_boosting_ok()
                 and not self.valid_score_updaters
-                and (cfg.metric_freq <= 0 or not self.training_metrics)
+                and (cfg.metric_freq <= 0 or not self.training_metrics
+                     or ignore_train_metrics)
                 and self.early_stopping_round <= 0
                 and getattr(self.objective, "_grad", None) is not None
                 and type(self.tree_learner).__name__ == "SerialTreeLearner")
@@ -356,22 +362,24 @@ class GBDT:
         inbag_fn = self._fused_inbag_fn()
 
         def step(score, xs):
-            fmask, it = xs
+            fmask, it = xs  # fmask: (K, F) — one mask PER CLASS TREE,
+            # matching the sequential path's per-tree feature sampling
+            # (serial_tree_learner.cpp:160-165 samples per Train call)
             g, h = grad_fn(score)
             gp = jnp.pad(g, ((0, 0), (0, pad)))
             hp = jnp.pad(h, ((0, 0), (0, pad)))
             # per-iteration in-bag weights (GOSS); pad rows stay zero
             ib = inbag if inbag_fn is None else inbag_fn(it, gp, hp) * inbag
             if num_class == 1:
-                out = core(bins, gp[0], hp[0], ib, fmask, nbpf, iscat)
+                out = core(bins, gp[0], hp[0], ib, fmask[0], nbpf, iscat)
                 upd = jnp.take(out["leaf_value"], out["row_leaf"][:n])[None, :]
             elif not use_partitioned:
                 # one device program for ALL classes: vmap the whole-tree
                 # builder over the class axis (SURVEY M2; the reference
                 # loops classes serially, gbdt.cpp:210-245)
                 out = jax.vmap(
-                    lambda gg, hh: core(bins, gg, hh, ib, fmask,
-                                        nbpf, iscat))(gp, hp)
+                    lambda gg, hh, fm: core(bins, gg, hh, ib, fm,
+                                            nbpf, iscat))(gp, hp, fmask)
                 upd = jax.vmap(
                     lambda lv, rl: jnp.take(lv, rl[:n]))(
                         out["leaf_value"], out["row_leaf"])
@@ -382,12 +390,13 @@ class GBDT:
                 # per class (still a single compiled program, matching
                 # the reference's sequential class loop)
                 def class_step(_, gh):
-                    gg, hh = gh
-                    o = core(bins, gg, hh, ib, fmask, nbpf, iscat)
+                    gg, hh, fm = gh
+                    o = core(bins, gg, hh, ib, fm, nbpf, iscat)
                     u = jnp.take(o["leaf_value"], o["row_leaf"][:n])
                     return None, (o, u)
 
-                _, (out, upd) = jax.lax.scan(class_step, None, (gp, hp))
+                _, (out, upd) = jax.lax.scan(class_step, None,
+                                             (gp, hp, fmask))
             score = score + upd * shrink
             del out["row_leaf"]  # keep the stacked ys O(iter * num_leaves)
             return score, out
@@ -396,7 +405,7 @@ class GBDT:
             return jax.lax.scan(step, score, (fmasks, iters))
 
         score = self.train_score_updater.score
-        fmasks = jnp.ones((num_iters, learner.f_pad), dtype=bool)
+        fmasks = jnp.ones((num_iters, num_class, learner.f_pad), dtype=bool)
         iters = jnp.arange(num_iters, dtype=jnp.int32)
         compiled = jax.jit(fused).lower(score, fmasks, iters).compile()
         self._fused_cache[key] = compiled
@@ -410,21 +419,26 @@ class GBDT:
             return True
         return False
 
-    def train_many(self, num_iters):
+    def train_many(self, num_iters, ignore_train_metrics=False):
         """Train `num_iters` boosting iterations; uses the fused in-graph
         scan when eligible, else the per-iteration loop. Returns True if
-        training stopped early."""
+        training stopped early. ignore_train_metrics runs the scan even
+        with training metrics attached (the caller prints between
+        blocks; application.py train)."""
         if num_iters <= 0:
             return False
-        if not self._fused_eligible():
+        if not self._fused_eligible(ignore_train_metrics):
             for _ in range(num_iters):
                 if self.train_one_iter():
                     return True
             return False
         fn = self._get_fused_fn(num_iters)
         learner = self.tree_learner
-        fmasks = jnp.asarray(
-            np.stack([learner._sample_features() for _ in range(num_iters)]))
+        # same RNG stream and consumption order as the sequential path:
+        # one mask per (iteration, class) tree
+        fmasks = jnp.asarray(np.stack(
+            [[learner._sample_features() for _ in range(self.num_class)]
+             for _ in range(num_iters)]))
         iters = jnp.arange(self.iter, self.iter + num_iters, dtype=jnp.int32)
         final_score, stacked = fn(self.train_score_updater.score, fmasks,
                                   iters)

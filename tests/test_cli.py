@@ -113,3 +113,27 @@ def test_weight_side_file_loaded():
     app.init_train()
     assert app.train_data.metadata.weights is not None
     assert len(app.train_data.metadata.weights) == 7000
+
+
+def test_block_fused_matches_sequential_metrics(tmp_path, capsys):
+    """Training-metric configs run as fused metric_freq blocks
+    (application.py train); the printed metric values must equal the
+    sequential per-iteration path's."""
+    def run(extra):
+        out = str(tmp_path / f"m{len(extra)}.txt")
+        app = Application([
+            "task=train", "objective=binary", "num_leaves=15",
+            "num_trees=6", "metric=binary_logloss",
+            "is_training_metric=true", "metric_freq=3", "verbose=1",
+            f"data={BINARY}/binary.train", f"output_model={out}"] + extra)
+        app.run()
+        return [l for l in capsys.readouterr().out.splitlines()
+                if "training logloss" in l]
+
+    fused_lines = run([])
+    # early_stopping_round > 0 disqualifies fusion (and never fires
+    # without a valid set), forcing the per-iteration path at the same
+    # metric cadence
+    seq_lines = run(["early_stopping_round=100"])
+    assert fused_lines, "no metric lines captured"
+    assert fused_lines == seq_lines

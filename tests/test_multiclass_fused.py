@@ -58,3 +58,47 @@ def test_multiclass_fused_matches_sequential():
     l2 = m.eval(b2.get_training_score())[0]
     assert abs(l1 - l2) < 1e-4
     assert l2 < 1.5  # learning is happening (log(10) ~ 2.3 at init)
+
+
+def test_multiclass_feature_fraction_fused_matches_sequential():
+    """With feature_fraction < 1 the fused scan must draw one mask per
+    (iteration, class) tree in the sequential path's RNG order — a
+    single shared per-iteration mask would silently diverge from the
+    per-class sampling of serial_tree_learner.cpp:160-165."""
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(13)
+    n, f, k = 1500, 10, 3
+    x = rng.rand(n, f).astype(np.float32)
+    y = (x[:, 0] * 3 + x[:, 1] * 2).astype(np.int32) % k
+    params = {"objective": "multiclass", "num_class": k, "num_leaves": 7,
+              "max_bin": 32, "feature_fraction": 0.6, "metric_freq": 0,
+              "min_data_in_leaf": 10}
+    n_iter = 3
+
+    def make():
+        cfg = Config.from_params(params)
+        ds = DatasetLoader(cfg).construct_from_matrix(
+            x, label=y.astype(np.float32))
+        obj = create_objective(cfg.objective, cfg)
+        obj.init(ds.metadata, ds.num_data)
+        b = GBDT()
+        b.init(cfg, ds, obj, [])
+        return b
+
+    b_seq = make()
+    for _ in range(n_iter):
+        b_seq.train_one_iter(is_eval=False)
+
+    b_fused = make()
+    assert b_fused.warm_up_fused(n_iter)
+    b_fused.train_many(n_iter)
+
+    assert len(b_seq.models) == len(b_fused.models) == n_iter * k
+    for ts, tf in zip(b_seq.models, b_fused.models):
+        np.testing.assert_array_equal(ts.split_feature, tf.split_feature)
+        np.testing.assert_array_equal(ts.threshold_in_bin, tf.threshold_in_bin)
