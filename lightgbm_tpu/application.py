@@ -185,16 +185,27 @@ class Application:
                          time.time() - start, self.boosting.iter)
             elif (fused is not None and cfg.metric_freq > 0
                     and fused(ignore_train_metrics=True)):
-                # training-metric output is the only blocker: run fused
-                # blocks of metric_freq iterations, printing between
+                # metric output (train and/or valid) is the only blocker:
+                # run fused blocks of metric_freq iterations, catching up
+                # valid scores from the block's trees and printing between
+                b = self.boosting
                 done = 0
                 while done < cfg.num_iterations:
                     step = min(cfg.metric_freq, cfg.num_iterations - done)
-                    stopped = self.boosting.train_many(
-                        step, ignore_train_metrics=True)
-                    if self.boosting.iter > done:  # block trained something
-                        done = self.boosting.iter
-                        self.boosting.output_metric(done)
+                    if step == cfg.metric_freq:
+                        stopped = b.train_many(step,
+                                               ignore_train_metrics=True)
+                    else:
+                        # tail shorter than a block: the per-iteration
+                        # loop avoids compiling a second scan length
+                        stopped = False
+                        for _ in range(step):
+                            if b.train_one_iter(is_eval=False):
+                                stopped = True
+                                break
+                    if b.iter > done:  # block trained something
+                        done = b.iter
+                        b.output_metric(done)
                         Log.info("%f seconds elapsed, finished iteration %d "
                                  "(fused block)", time.time() - start, done)
                     if stopped:

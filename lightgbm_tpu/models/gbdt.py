@@ -323,13 +323,14 @@ class GBDT:
 
     def _fused_eligible(self, ignore_train_metrics=False):
         """ignore_train_metrics=True answers "could this train fused in
-        metric_freq-sized blocks, with metric output between blocks?"
-        (the CLI uses it, application.py train)."""
+        metric_freq-sized blocks, with metric output (and valid-set
+        score catch-up from the block's materialized trees) between
+        blocks?" (the CLI uses it, application.py train)."""
         cfg = self.config
         if cfg is None or self.objective is None:
             return False
         return (self._fused_boosting_ok()
-                and not self.valid_score_updaters
+                and (not self.valid_score_updaters or ignore_train_metrics)
                 and (cfg.metric_freq <= 0 or not self.training_metrics
                      or ignore_train_metrics)
                 and self.early_stopping_round <= 0
@@ -458,6 +459,7 @@ class GBDT:
                 return {key: v[t] for key, v in host.items()}
             return {key: v[t, k] for key, v in host.items()}
 
+        n_before = len(self.models)
         for t in range(t_eff):
             for k in range(self.num_class):
                 self.models.append(learner.host_out_to_tree(
@@ -467,6 +469,15 @@ class GBDT:
                 self.models.append(learner.host_out_to_tree(
                     slice_at(t_eff, k), shrink=self.shrinkage_rate))
         self.iter += t_eff
+        # valid scores stay in sync with the model list no matter who
+        # called (the scan only carries TRAIN scores): one batched
+        # update per valid set for the whole block
+        if self.valid_score_updaters and len(self.models) > n_before:
+            new_trees = self.models[n_before:]
+            classes = [i % self.num_class
+                       for i in range(n_before, len(self.models))]
+            for updater in self.valid_score_updaters:
+                updater.add_score_by_trees(new_trees, classes)
         if t_eff < num_iters:
             Log.info("Stopped training because there are no more leafs "
                      "that meet the split requirements.")

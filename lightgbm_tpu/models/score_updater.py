@@ -135,6 +135,14 @@ class ScoreUpdater:
             delta[i % num_class] -= tree.predict_by_bins(self.dataset.traversal_bins())
         self.score = self.score + jnp.asarray(delta)
 
+    def add_score_by_trees(self, trees, classes):
+        """Batched addition of (tree, class) pairs: ONE device update
+        total (valid-score catch-up after a fused block, gbdt.train_many)."""
+        delta = np.zeros((self.num_class, self.num_data), dtype=np.float32)
+        for tree, k in zip(trees, classes):
+            delta[k] += tree.predict_by_bins(self.dataset.traversal_bins())
+        self.score = self.score + jnp.asarray(delta)
+
     def host_score(self):
         """Flat class-major (K*N,) float64 host array (the reference's
         score layout, score[k*N + i])."""

@@ -125,10 +125,12 @@ def test_block_fused_matches_sequential_metrics(tmp_path, capsys):
             "task=train", "objective=binary", "num_leaves=15",
             "num_trees=6", "metric=binary_logloss",
             "is_training_metric=true", "metric_freq=3", "verbose=1",
-            f"data={BINARY}/binary.train", f"output_model={out}"] + extra)
+            f"data={BINARY}/binary.train",
+            f"valid_data={BINARY}/binary.test",
+            f"output_model={out}"] + extra)
         app.run()
         return [l for l in capsys.readouterr().out.splitlines()
-                if "training logloss" in l]
+                if "logloss" in l]
 
     fused_lines = run([])
     # early_stopping_round > 0 disqualifies fusion (and never fires
