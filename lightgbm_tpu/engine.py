@@ -82,10 +82,13 @@ def train(params, train_set, num_boost_round=100,
                 name_valid_sets.append("valid_" + str(i))
 
     callbacks = _configure_callbacks(callbacks)
+    default_print_cb = None
     if verbose_eval is True:
-        callbacks.add(callback.print_evaluation())
+        default_print_cb = callback.print_evaluation()
+        callbacks.add(default_print_cb)
     elif isinstance(verbose_eval, int) and not isinstance(verbose_eval, bool):
-        callbacks.add(callback.print_evaluation(verbose_eval))
+        default_print_cb = callback.print_evaluation(verbose_eval)
+        callbacks.add(default_print_cb)
     if early_stopping_rounds is not None:
         callbacks.add(callback.early_stopping(
             early_stopping_rounds, verbose=bool(verbose_eval)))
@@ -100,6 +103,22 @@ def train(params, train_set, num_boost_round=100,
         booster.set_train_data_name(train_data_name)
     for valid_set, name_valid_set in zip(reduced_valid_sets, name_valid_sets):
         booster.add_valid(valid_set, name_valid_set)
+
+    # fast path: nothing needs the per-round boundary (no callbacks, no
+    # custom objective, no valid evaluation) — run the whole block as
+    # the fused device scan (gbdt.train_many); semantics are identical
+    # (parity pinned by tests/test_core_training.py and the fused GOSS/
+    # bagging tests). The default print_evaluation callback is exempt:
+    # with no valid sets its evaluation list is always empty and it
+    # prints nothing (callback.py).
+    effective_after = [cb for cb in callbacks_after_iter
+                       if cb is not default_print_cb]
+    if (not callbacks_before_iter and not effective_after
+            and fobj is None and valid_sets is None
+            and getattr(booster.gbdt, "_fused_eligible", lambda: False)()):
+        booster.gbdt.train_many(num_boost_round)
+        booster.best_iteration = num_boost_round
+        return booster
 
     for i in range(init_iteration, init_iteration + num_boost_round):
         for cb in callbacks_before_iter:

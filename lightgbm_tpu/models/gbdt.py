@@ -473,11 +473,11 @@ class GBDT:
         # called (the scan only carries TRAIN scores): one batched
         # update per valid set for the whole block
         if self.valid_score_updaters and len(self.models) > n_before:
+            # n_before is a multiple of num_class (partial-class appends
+            # only happen when training ends), so the slice is class-major
             new_trees = self.models[n_before:]
-            classes = [i % self.num_class
-                       for i in range(n_before, len(self.models))]
             for updater in self.valid_score_updaters:
-                updater.add_score_by_trees(new_trees, classes)
+                updater.add_score_by_trees(new_trees, self.num_class)
         if t_eff < num_iters:
             Log.info("Stopped training because there are no more leafs "
                      "that meet the split requirements.")

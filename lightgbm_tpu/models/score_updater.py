@@ -127,21 +127,19 @@ class ScoreUpdater:
         vals = tree.predict_by_bins(self.dataset.traversal_bins()).astype(np.float32)
         self.score = self.score.at[curr_class].add(jnp.asarray(-vals))
 
-    def sub_score_by_trees(self, trees, num_class):
-        """Batched subtraction of many class-major trees: one host pass and
-        ONE device update total (used by early-stopping truncation)."""
+    def add_score_by_trees(self, trees, num_class, sign=1.0):
+        """Batched update from many class-major trees: one host pass and
+        ONE device update total. sign=+1: valid-score catch-up after a
+        fused block (gbdt.train_many); sign=-1: early-stopping
+        truncation."""
         delta = np.zeros((self.num_class, self.num_data), dtype=np.float32)
         for i, tree in enumerate(trees):
-            delta[i % num_class] -= tree.predict_by_bins(self.dataset.traversal_bins())
+            delta[i % num_class] += sign * tree.predict_by_bins(
+                self.dataset.traversal_bins())
         self.score = self.score + jnp.asarray(delta)
 
-    def add_score_by_trees(self, trees, classes):
-        """Batched addition of (tree, class) pairs: ONE device update
-        total (valid-score catch-up after a fused block, gbdt.train_many)."""
-        delta = np.zeros((self.num_class, self.num_data), dtype=np.float32)
-        for tree, k in zip(trees, classes):
-            delta[k] += tree.predict_by_bins(self.dataset.traversal_bins())
-        self.score = self.score + jnp.asarray(delta)
+    def sub_score_by_trees(self, trees, num_class):
+        self.add_score_by_trees(trees, num_class, sign=-1.0)
 
     def host_score(self):
         """Flat class-major (K*N,) float64 host array (the reference's

@@ -132,10 +132,18 @@ def test_block_fused_matches_sequential_metrics(tmp_path, capsys):
         return [l for l in capsys.readouterr().out.splitlines()
                 if "logloss" in l]
 
+    def values(lines):
+        return [float(l.rsplit(":", 1)[1]) for l in lines]
+
     fused_lines = run([])
-    # early_stopping_round > 0 disqualifies fusion (and never fires
-    # without a valid set), forcing the per-iteration path at the same
-    # metric cadence
+    # early_stopping_round > 0 disqualifies fusion, forcing the
+    # per-iteration path at the same metric cadence (it never fires
+    # within 6 rounds at patience 100)
     seq_lines = run(["early_stopping_round=100"])
     assert fused_lines, "no metric lines captured"
-    assert fused_lines == seq_lines
+    assert len(fused_lines) == len(seq_lines)
+    # fused catch-up scores valid sets host-side in f64, the sequential
+    # path on device in f32: compare values with a tolerance instead of
+    # the %g strings
+    np.testing.assert_allclose(values(fused_lines), values(seq_lines),
+                               rtol=1e-5)
