@@ -103,13 +103,17 @@ def _seg_hist_kernel(lohi_ref, words_ref, ghc_ref, out_ref, *, f, b_pad):
             preferred_element_type=jnp.float32)                   # (B_pad, 3)
 
 
-def _seg_hist_tpu(words_sl, ghc_sl, lo, hi, f, num_bins_total, n_blocks):
-    """Pallas segment histogram over a chunk-aligned slice."""
+def _seg_hist_tpu(words_sl, ghc_sl, lo, hi, f, num_bins_total, n_blocks,
+                  interpret=False):
+    """Pallas segment histogram over a chunk-aligned slice. `interpret`
+    runs the kernel body in pallas interpret mode (CPU) — used by tests
+    to validate kernel semantics without TPU hardware."""
     w = words_sl.shape[0]
     b_pad = max(((num_bins_total + 127) // 128) * 128, 128)
     kernel = functools.partial(_seg_hist_kernel, f=f, b_pad=b_pad)
     out = pl.pallas_call(
         kernel,
+        interpret=interpret,
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # (2,) lo/hi
@@ -141,7 +145,7 @@ def _seg_hist_xla(words_sl, ghc_sl, lo, hi, f, num_bins_total):
 
 
 def segment_histograms(words, ghc_t, begin, cnt, num_bins_total, f,
-                       interpret_backend=None):
+                       interpret_backend=None, interpret=False):
     """hist[f, b, k] over the position range [begin, begin+cnt).
 
     Args:
@@ -186,7 +190,7 @@ def segment_histograms(words, ghc_t, begin, cnt, num_bins_total, f,
             hi = lo + cnt
             if on_tpu:
                 return _seg_hist_tpu(words_sl, ghc_sl, lo, hi, f,
-                                     num_bins_total, bk)
+                                     num_bins_total, bk, interpret=interpret)
             return _seg_hist_xla(words_sl, ghc_sl, lo, hi, f, num_bins_total)
         return branch
 

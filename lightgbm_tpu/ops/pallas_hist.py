@@ -58,7 +58,8 @@ def _hist_kernel(leaf_ref, bins_ref, ghc_ref, rl_ref, out_ref, *, f, b_pad):
             preferred_element_type=jnp.float32)                   # (B_pad, 3)
 
 
-def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total):
+def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
+                          interpret=False):
     """hist[f, b, k] over rows with row_leaf == leaf_id (TPU kernel).
 
     Args:
@@ -79,6 +80,7 @@ def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total):
     kernel = functools.partial(_hist_kernel, f=f, b_pad=b_pad)
     out = pl.pallas_call(
         kernel,
+        interpret=interpret,  # CPU kernel-semantics tests
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # leaf id (1,)
