@@ -11,7 +11,8 @@ O(leaf_rows) instead of the masked builder's O(N) per split
 (ops/pallas_hist.py BASELINE.md bound).
 
 Static shapes under jit come from BUCKETING: segment lengths are
-rounded up to a power-of-two number of HIST_CHUNK-row chunks and
+rounded up to a geometric-bucket number of HIST_CHUNK-row chunks
+(power-of-two by default, see _bucket_growth) and
 `lax.switch` dispatches to the matching pre-compiled variant; boundary
 chunks mask rows outside the range by position (two iota compares —
 there is no row_leaf array at all on this path).
@@ -50,13 +51,33 @@ def unpack_feature(words, feat):
     return (word >> ((feat & 3) * 8)) & 0xFF
 
 
+def _bucket_growth():
+    """Geometric growth factor of the segment buckets. 2 (default)
+    minimizes streaming waste (<2x per segment) at ~log2(n_chunks)
+    compiled kernel variants; LIGHTGBM_TPU_BUCKET_GROWTH=4 halves the
+    variant count (faster compile) at <4x worst-case waste — a knob for
+    tuning compile-time vs throughput on real hardware."""
+    import os
+    raw = os.environ.get("LIGHTGBM_TPU_BUCKET_GROWTH", "2")
+    try:
+        growth = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"LIGHTGBM_TPU_BUCKET_GROWTH must be an integer >= 2, got {raw!r}")
+    if growth < 2:
+        raise ValueError(
+            f"LIGHTGBM_TPU_BUCKET_GROWTH must be >= 2, got {raw!r}")
+    return growth
+
+
 def bucket_sizes(n_chunks):
-    """Power-of-two chunk buckets up to the full array."""
+    """Geometric chunk buckets up to the full array (see _bucket_growth)."""
+    growth = _bucket_growth()
     sizes = []
     b = 1
     while b < n_chunks:
         sizes.append(b)
-        b *= 2
+        b *= growth
     sizes.append(n_chunks)
     return sizes
 
@@ -156,8 +177,8 @@ def segment_histograms(words, ghc_t, begin, cnt, num_bins_total, f,
       num_bins_total: static histogram width B.
       f: static real feature count (<= 4W).
 
-    Returns (F, B, 3) float32. Cost scales with the power-of-two chunk
-    bucket covering the segment, not with N.
+    Returns (F, B, 3) float32. Cost scales with the geometric chunk
+    bucket covering the segment (bucket_sizes), not with N.
     """
     w, n = words.shape
     if n % HIST_CHUNK != 0:
