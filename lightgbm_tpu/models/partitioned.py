@@ -19,7 +19,7 @@ This builder keeps the bin matrix PHYSICALLY sorted by leaf:
   pass + one scatter + gathers (ops/partition.py) — the TPU analog of
   DataPartition::Split's per-thread buffers + prefix-sum copy-back;
 - the smaller child's histogram streams only the chunks covering its
-  segment (power-of-two bucketed `lax.switch`, ops/ordered_hist.py);
+  segment (geometric-bucketed `lax.switch`, ops/ordered_hist.py);
   the larger child is parent - smaller, as everywhere else.
 
 Semantics (split scans, gain formulas, tie-breaks, depth guard,
@@ -49,7 +49,7 @@ from .tree_learner import apply_tree_split, init_split_state, write_candidate
 
 def _partition_segment(words, ghc, perm, seg_b, seg_c, feat, thr, cat):
     """Stable-partition the segment [seg_b, seg_b+seg_c) by the split
-    decision, touching only the power-of-two chunk bucket covering it.
+    decision, touching only the geometric chunk bucket covering it.
 
     The permutation is identical to a full-array stable partition —
     split_destinations runs on the slice with slice-local bounds, where

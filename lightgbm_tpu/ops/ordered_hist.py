@@ -51,12 +51,7 @@ def unpack_feature(words, feat):
     return (word >> ((feat & 3) * 8)) & 0xFF
 
 
-def _bucket_growth():
-    """Geometric growth factor of the segment buckets. 2 (default)
-    minimizes streaming waste (<2x per segment) at ~log2(n_chunks)
-    compiled kernel variants; LIGHTGBM_TPU_BUCKET_GROWTH=4 halves the
-    variant count (faster compile) at <4x worst-case waste — a knob for
-    tuning compile-time vs throughput on real hardware."""
+def _parse_bucket_growth():
     import os
     raw = os.environ.get("LIGHTGBM_TPU_BUCKET_GROWTH", "2")
     try:
@@ -70,9 +65,18 @@ def _bucket_growth():
     return growth
 
 
+# Geometric growth factor of the segment buckets, read ONCE at import
+# (consistent for the process lifetime — jitted programs bake it in).
+# 2 (default) minimizes streaming waste (<2x per segment) at
+# ~log2(n_chunks) compiled kernel variants; LIGHTGBM_TPU_BUCKET_GROWTH=4
+# halves the variant count (faster compile) at <4x worst-case waste — a
+# knob for tuning compile-time vs throughput on real hardware.
+BUCKET_GROWTH = _parse_bucket_growth()
+
+
 def bucket_sizes(n_chunks):
-    """Geometric chunk buckets up to the full array (see _bucket_growth)."""
-    growth = _bucket_growth()
+    """Geometric chunk buckets up to the full array (see BUCKET_GROWTH)."""
+    growth = BUCKET_GROWTH
     sizes = []
     b = 1
     while b < n_chunks:

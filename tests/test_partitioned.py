@@ -197,3 +197,47 @@ def test_partitioned_binary_quality():
     m.init(booster.train_data.metadata, booster.train_data.num_data)
     auc = float(m.eval(booster.get_training_score())[0])
     assert auc > 0.95, auc
+
+
+def test_partitioned_categorical_matches_masked():
+    """Categorical splits (one-vs-rest, col == threshold) through the
+    partitioned builder's packed-word decision path must match the
+    masked builder's trees."""
+    rng = np.random.RandomState(21)
+    n = 3000
+    x = np.column_stack([
+        rng.randint(0, 12, size=n).astype(np.float32),   # categorical
+        rng.randint(0, 5, size=n).astype(np.float32),    # categorical
+        rng.rand(n).astype(np.float32),
+        rng.rand(n).astype(np.float32),
+    ])
+    logit = (np.isin(x[:, 0], [2, 5, 7]) * 1.5 + (x[:, 1] == 3) * 1.0
+             + x[:, 2] - 0.5 * x[:, 3])
+    y = (logit + 0.2 * rng.randn(n) > 0.8).astype(np.float32)
+
+    def train(partitioned):
+        cfg = Config.from_params({
+            "objective": "binary", "num_leaves": 15, "max_bin": 32,
+            "min_data_in_leaf": 20, "metric_freq": 0,
+            "partitioned_build": partitioned})
+        ds = DatasetLoader(cfg).construct_from_matrix(
+            x, label=y, categorical_features=(0, 1))
+        obj = create_objective(cfg.objective, cfg)
+        obj.init(ds.metadata, ds.num_data)
+        b = GBDT()
+        b.init(cfg, ds, obj, [])
+        b.train_many(6)
+        return b
+
+    bm = train("false")
+    bp = train("true")
+    assert bp.tree_learner._use_partitioned
+    assert any((t.decision_type == 1).any() for t in bm.models), \
+        "data should produce at least one categorical split"
+    assert len(bm.models) == len(bp.models)
+    for tm, tp in zip(bm.models, bp.models):
+        np.testing.assert_array_equal(tm.split_feature, tp.split_feature)
+        np.testing.assert_array_equal(tm.threshold_in_bin, tp.threshold_in_bin)
+        np.testing.assert_array_equal(tm.decision_type, tp.decision_type)
+    np.testing.assert_allclose(bm.predict(x), bp.predict(x),
+                               rtol=1e-4, atol=1e-5)
