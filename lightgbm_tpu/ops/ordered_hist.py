@@ -12,7 +12,7 @@ O(leaf_rows) instead of the masked builder's O(N) per split
 
 Static shapes under jit come from BUCKETING: segment lengths are
 rounded up to a geometric-bucket number of HIST_CHUNK-row chunks
-(power-of-two by default, see _bucket_growth) and
+(power-of-two by default, see BUCKET_GROWTH) and
 `lax.switch` dispatches to the matching pre-compiled variant; boundary
 chunks mask rows outside the range by position (two iota compares —
 there is no row_leaf array at all on this path).

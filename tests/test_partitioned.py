@@ -241,3 +241,38 @@ def test_partitioned_categorical_matches_masked():
         np.testing.assert_array_equal(tm.decision_type, tp.decision_type)
     np.testing.assert_allclose(bm.predict(x), bp.predict(x),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_partitioned_matches_masked_random_configs(seed):
+    """Bounded fuzz: random data + random config knobs (leaves, bins,
+    min_data, bagging, feature_fraction, depth) must grow identical
+    trees under both builders."""
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(1500, 5000))
+    f = int(rng.randint(4, 14))
+    x = rng.rand(n, f).astype(np.float32)
+    w_true = rng.randn(f)
+    y = ((x @ w_true + 0.3 * rng.randn(n)) > np.median(x @ w_true)).astype(
+        np.float32)
+    params = {
+        "objective": "binary",
+        "num_leaves": int(rng.choice([7, 15, 31])),
+        "max_bin": int(rng.choice([16, 64, 255])),
+        "min_data_in_leaf": int(rng.choice([5, 20, 50])),
+        "max_depth": int(rng.choice([-1, 4])),
+        "bagging_fraction": float(rng.choice([1.0, 0.8])),
+        "bagging_freq": 1,
+        "feature_fraction": float(rng.choice([1.0, 0.7])),
+        "metric_freq": 0,
+    }
+    n_iter = 4
+    bm = _train(x, y, dict(params, partitioned_build="false"), n_iter)
+    bp = _train(x, y, dict(params, partitioned_build="true"), n_iter)
+    assert bp.tree_learner._use_partitioned  # guard against vacuous pass
+    assert len(bm.models) == len(bp.models)
+    for tm, tp in zip(bm.models, bp.models):
+        np.testing.assert_array_equal(tm.split_feature, tp.split_feature)
+        np.testing.assert_array_equal(tm.threshold_in_bin, tp.threshold_in_bin)
+    np.testing.assert_allclose(bm.predict(x), bp.predict(x),
+                               rtol=1e-4, atol=1e-5)
