@@ -12,14 +12,11 @@ strong reference until the matching *Free call.
 """
 
 import ctypes
-import json
 
 import numpy as np
 
-from .basic import Booster, Dataset, _InnerPredictor
-from .config import Config, str2map
-from .io.dataset import DatasetLoader
-from .utils.log import Log
+from .basic import Booster, Dataset
+from .config import str2map
 
 C_API_DTYPE_FLOAT32 = 0
 C_API_DTYPE_FLOAT64 = 1

@@ -7,7 +7,7 @@ same names and defaults, flat, because the TPU build passes a single
 hashable config into jitted tree-build steps).
 """
 
-from dataclasses import dataclass, field, fields
+from dataclasses import dataclass, fields
 
 from .utils.log import Log, check
 from .utils.random import Random

@@ -17,8 +17,7 @@ float64 matrix of the in-memory path.
 
 import numpy as np
 
-from ..utils.log import Log
-from .parser import detect_format, libsvm_pairs, NA_VALUES, ZERO_THRESHOLD
+from .parser import libsvm_pairs, NA_VALUES
 
 DEFAULT_BLOCK_ROWS = 1 << 16
 

@@ -19,7 +19,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..metrics import create_metric
 from ..utils import common
 from ..utils.log import Log
 from ..utils.timers import TIMERS

@@ -42,8 +42,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.tree_learner import SerialTreeLearner, build_tree_device
-from ..ops.split import (SplitParams, find_best_split, per_feature_best,
-                         split_info_at, K_MIN_SCORE)
+from ..ops.split import find_best_split, per_feature_best, split_info_at
 from ..utils.log import Log
 
 AXIS = "data"

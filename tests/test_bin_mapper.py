@@ -2,7 +2,7 @@
 
 import numpy as np
 
-from lightgbm_tpu.io.bin_mapper import BinMapper, NUMERICAL, CATEGORICAL
+from lightgbm_tpu.io.bin_mapper import BinMapper, CATEGORICAL
 
 
 def test_few_distinct_values_midpoint_bounds():

@@ -3,7 +3,6 @@ reference suite (tests/python_package_test/test_engine.py:40-66 uses
 binary logloss<0.15, regression RMSE<4, multiclass mlogloss<0.2)."""
 
 import numpy as np
-import pytest
 from sklearn import datasets
 from sklearn.model_selection import train_test_split
 

@@ -13,7 +13,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 BINARY_TRAIN = "/root/reference/examples/binary_classification/binary.train"
 

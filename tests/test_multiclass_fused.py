@@ -8,7 +8,6 @@ structurally (>=90% identical trees) and numerically (scores ~1e-5).
 """
 
 import numpy as np
-import pytest
 from sklearn import datasets
 
 from lightgbm_tpu.config import Config

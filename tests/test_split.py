@@ -4,7 +4,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from lightgbm_tpu.ops.split import (
-    SplitParams, find_best_split, leaf_split_gain, leaf_output, K_EPSILON)
+    SplitParams, find_best_split, leaf_split_gain, leaf_output)
 
 P0 = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0,
                  lambda_l1=0.0, lambda_l2=0.0, min_gain_to_split=0.0)
