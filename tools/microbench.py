@@ -144,6 +144,26 @@ def main():
         chain_time(seg_step, (jnp.int32(1), jnp.float32(0)), k,
                    f"segment_hist seg={seg}")
 
+    # the partition step at several segment sizes (the second hot op of
+    # the partitioned builder: slice + stable partition + write-back)
+    from lightgbm_tpu.models.partitioned import _partition_segment
+    perm0 = jnp.arange(n_pad, dtype=jnp.int32)
+    for seg in [HIST_CHUNK, 16 * HIST_CHUNK, n_pad]:
+        seg = min(seg, n_pad)
+
+        def part_step(carry, seg=seg):
+            w, g, p = carry
+            # data dependency rides the threshold (doesn't change the
+            # segment geometry, so the labeled bucket is what's timed)
+            w2, g2, p2, nl = _partition_segment(
+                w, g, p, jnp.int32(0), jnp.int32(seg),
+                jnp.int32(3), jnp.int32(100) + (p[0] % 2),
+                jnp.asarray(False))
+            return (w2, g2, p2)
+
+        chain_time(part_step, (words28, ghc_t, perm0), k,
+                   f"partition seg={seg}")
+
 
 if __name__ == "__main__":
     main()
