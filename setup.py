@@ -1,0 +1,18 @@
+"""Package installer (parity with the reference's python-package/setup.py).
+
+The library is pure Python on top of the baked-in jax stack; the C API
+shim (`make` -> lib_lightgbm.so) is built separately and only needed by
+ctypes consumers of the reference C surface.
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="lightgbm_tpu",
+    version="0.1.0",
+    description=("TPU-native gradient boosting framework with the "
+                 "capability surface of early LightGBM"),
+    packages=find_packages(include=["lightgbm_tpu", "lightgbm_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=["numpy", "pandas", "jax"],
+)
