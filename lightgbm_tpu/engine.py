@@ -35,6 +35,74 @@ def _split_callbacks(callbacks):
             sorted(after, key=attrgetter("order")))
 
 
+def _train_blockwise(booster, callbacks_after_iter, init_iteration,
+                     num_boost_round, is_valid_contain_train, feval,
+                     early_stopping_rounds):
+    """Fused multi-iteration training with per-iteration callback
+    replay (see the blockwise comment in train()). Each block is ONE
+    device program (gbdt.train_many_eval); metric values for every
+    iteration inside the block come from device-computed score
+    snapshots. An early-stop break mid-block drops the overshoot
+    trees scorelessly — the snapshot already IS the kept state."""
+    gbdt = booster.gbdt
+    end = init_iteration + num_boost_round
+    # overshoot past the true stopping round costs at most block-1
+    # wasted iterations, so tie the block to the early-stop patience
+    if early_stopping_rounds is None:
+        block_full = num_boost_round
+    else:
+        block_full = min(num_boost_round,
+                         max(5, min(int(early_stopping_rounds), 25)))
+
+    def run_callbacks(i):
+        """One iteration's eval + after-iteration callbacks against the
+        CURRENT scores. Returns True on EarlyStopException."""
+        evaluation_result_list = []
+        if is_valid_contain_train:
+            evaluation_result_list.extend(booster.eval_train(feval))
+        evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after_iter:
+                cb(callback.CallbackEnv(
+                    model=booster, cvfolds=None, iteration=i,
+                    begin_iteration=init_iteration, end_iteration=end,
+                    evaluation_result_list=evaluation_result_list))
+        except callback.EarlyStopException:
+            return True
+        return False
+
+    i = init_iteration
+    while i < end:
+        t_eff, snap = gbdt.train_many_eval(min(block_full, end - i))
+        for t in range(t_eff):
+            snap.set_scores_at(t, with_train=is_valid_contain_train)
+            if run_callbacks(i + t):
+                snap.set_scores_at(t, with_train=True)
+                snap.drop_tail_to(t)
+                return
+        if snap.finalize():
+            # natural stop (an empty tree mid-block). The per-iteration
+            # path this replay must match does NOT end here: the
+            # reference python API ignores update()'s is-finished flag
+            # and keeps calling it — evals repeat, and per-iteration
+            # sampling (or multiclass gradient coupling) can resume
+            # real splitting. First replay the stop iteration's
+            # callbacks (its partial-class trees are already applied to
+            # the scores), then hand the remaining rounds to the true
+            # per-iteration loop.
+            i += t_eff
+            if i < end and run_callbacks(i):
+                return
+            i += 1
+            while i < end:
+                booster.update()
+                if run_callbacks(i):
+                    return
+                i += 1
+            return
+        i += t_eff
+
+
 def train(params, train_set, num_boost_round=100,
           valid_sets=None, valid_names=None,
           fobj=None, feval=None, init_model=None,
@@ -82,7 +150,7 @@ def train(params, train_set, num_boost_round=100,
                 name_valid_sets.append("valid_" + str(i))
 
     callbacks = _configure_callbacks(callbacks)
-    default_print_cb = None
+    default_print_cb = early_stop_cb = record_cb = None
     if verbose_eval is True:
         default_print_cb = callback.print_evaluation()
         callbacks.add(default_print_cb)
@@ -90,12 +158,14 @@ def train(params, train_set, num_boost_round=100,
         default_print_cb = callback.print_evaluation(verbose_eval)
         callbacks.add(default_print_cb)
     if early_stopping_rounds is not None:
-        callbacks.add(callback.early_stopping(
-            early_stopping_rounds, verbose=bool(verbose_eval)))
+        early_stop_cb = callback.early_stopping(
+            early_stopping_rounds, verbose=bool(verbose_eval))
+        callbacks.add(early_stop_cb)
     if learning_rates is not None:
         callbacks.add(callback.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
-        callbacks.add(callback.record_evaluation(evals_result))
+        record_cb = callback.record_evaluation(evals_result)
+        callbacks.add(record_cb)
     callbacks_before_iter, callbacks_after_iter = _split_callbacks(callbacks)
 
     booster = Booster(params=params, train_set=train_set)
@@ -120,27 +190,51 @@ def train(params, train_set, num_boost_round=100,
         booster.best_iteration = num_boost_round
         return booster
 
-    for i in range(init_iteration, init_iteration + num_boost_round):
-        for cb in callbacks_before_iter:
-            cb(callback.CallbackEnv(model=booster, cvfolds=None, iteration=i,
-                                    begin_iteration=init_iteration,
-                                    end_iteration=init_iteration + num_boost_round,
-                                    evaluation_result_list=None))
-        booster.update(fobj=fobj)
-
-        evaluation_result_list = []
-        if valid_sets is not None:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after_iter:
+    # blockwise fused path (valid sets and/or early stopping present):
+    # every callback here is one this function itself created from a
+    # kwarg, so the per-iteration callback protocol can be REPLAYED
+    # after a fused multi-iteration device block from per-iteration
+    # score snapshots (gbdt.train_many_eval) — observable behavior
+    # (eval values, print cadence, evals_result history, early-stop
+    # round, final model) is identical to the per-iteration loop, but
+    # tree building never leaves the device mid-block. Custom user
+    # callbacks fall back to the true per-iteration loop: they may
+    # mutate the booster mid-training.
+    engine_created = {cb for cb in (default_print_cb, early_stop_cb,
+                                    record_cb) if cb is not None}
+    use_blockwise = (
+        valid_sets is not None
+        and fobj is None
+        and not callbacks_before_iter
+        and all(cb in engine_created for cb in callbacks_after_iter)
+        and getattr(booster.gbdt, "_fused_eligible", lambda **_: False)(
+            ignore_train_metrics=True))
+    if use_blockwise:
+        _train_blockwise(booster, callbacks_after_iter, init_iteration,
+                         num_boost_round, is_valid_contain_train, feval,
+                         early_stopping_rounds)
+    else:
+        for i in range(init_iteration, init_iteration + num_boost_round):
+            for cb in callbacks_before_iter:
                 cb(callback.CallbackEnv(model=booster, cvfolds=None, iteration=i,
                                         begin_iteration=init_iteration,
                                         end_iteration=init_iteration + num_boost_round,
-                                        evaluation_result_list=evaluation_result_list))
-        except callback.EarlyStopException:
-            break
+                                        evaluation_result_list=None))
+            booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            if valid_sets is not None:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after_iter:
+                    cb(callback.CallbackEnv(model=booster, cvfolds=None, iteration=i,
+                                            begin_iteration=init_iteration,
+                                            end_iteration=init_iteration + num_boost_round,
+                                            evaluation_result_list=evaluation_result_list))
+            except callback.EarlyStopException:
+                break
     if booster.attr("best_iteration") is not None:
         booster.best_iteration = int(booster.attr("best_iteration")) + 1
     else:

@@ -71,6 +71,127 @@ class LazyTree:
         return getattr(self.materialize(), name)
 
 
+class _BlockSnapshots:
+    """Per-iteration score snapshots over one fused training block.
+
+    After GBDT._run_fused_block, the block's tree arrays are still
+    stacked on device. For every bound dataset, the score after
+    in-block iteration t is base + cumsum(deltas)[t], where the deltas
+    come from ONE vmapped bin-space traversal per chunk
+    (score_updater._stacked_deltas) — so the engine can replay the
+    reference's per-iteration eval/early-stop callback protocol
+    (gbdt.cpp:210-349) without a single training-loop host sync.
+    Chunking bounds device memory to ~CHUNK_BYTES per dataset; the
+    caller walks t forward, so chunks stream.
+    """
+
+    CHUNK_BYTES = 64 << 20
+
+    def __init__(self, gbdt, stacked, base_train, base_valids, t_eff,
+                 n_before, k_stop, natural_stop):
+        self._gbdt = gbdt
+        self._stacked = stacked
+        self._t_eff = t_eff
+        self._n_before = n_before
+        self._k_stop = k_stop
+        self._natural_stop = natural_stop
+        self._scan_final_train = gbdt.train_score_updater.score
+        self._states = [self._new_state(gbdt.train_score_updater,
+                                        base_train)]
+        for u, b in zip(gbdt.valid_score_updaters, base_valids):
+            self._states.append(self._new_state(u, b))
+
+    @staticmethod
+    def _new_state(updater, base):
+        return {"updater": updater, "base": base, "next": 0,
+                "c0": 0, "chunk": None, "carry": None}
+
+    def _flat_slice(self, t0, t1):
+        """Stacked arrays sliced to [t0, t1) with the (iter, class) axes
+        flattened to one leading tree axis."""
+        k = self._gbdt.num_class
+        out = {}
+        for key, v in self._stacked.items():
+            s = v[t0:t1]
+            if k > 1:
+                s = s.reshape(((t1 - t0) * k,) + tuple(s.shape[2:]))
+            out[key] = s
+        return out
+
+    def _row_at(self, st, t):
+        gb = self._gbdt
+        k = gb.num_class
+        u = st["updater"]
+        if st["chunk"] is not None and t < st["c0"]:
+            raise ValueError("snapshots must be walked forward")
+        while st["chunk"] is None or t >= st["c0"] + st["chunk"].shape[0]:
+            c0 = st["next"]
+            v = u.num_data
+            csz = max(1, min(self._t_eff - c0,
+                             self.CHUNK_BYTES // max(1, k * v * 4)))
+            deltas = u.deltas_by_stacked_device_trees(
+                self._flat_slice(c0, c0 + csz), gb.shrinkage_rate)
+            deltas = deltas.reshape(csz, k, v)
+            carry = st["carry"] if st["carry"] is not None else st["base"]
+            cum = carry[None] + jnp.cumsum(deltas, axis=0)
+            st["carry"] = cum[-1]
+            st["c0"], st["chunk"], st["next"] = c0, cum, c0 + csz
+        return st["chunk"][t - st["c0"]]
+
+    def drop_tail_to(self, t):
+        """Early-stop break at in-block iteration t: drop every tree
+        past iteration t WITHOUT score adjustment (the caller has set
+        all scores to the t snapshot). Accounts for the k_stop
+        partial-class trees a natural-stop block appends beyond its
+        t_eff full iterations — a plain per-iteration count would leave
+        them behind and break the class-major model layout."""
+        gb = self._gbdt
+        n_drop = (self._t_eff - (t + 1)) * gb.num_class
+        if self._natural_stop:
+            n_drop += self._k_stop
+        if n_drop > 0:
+            del gb.models[-n_drop:]
+        gb.iter -= self._t_eff - (t + 1)
+
+    def set_scores_at(self, t, with_train=False):
+        """Point every bound updater's score at the post-iteration-t
+        state (t 0-based within the block). The train updater only
+        moves when with_train (train-set metrics requested, or fixing
+        state on an early-stop break) — its canonical final value comes
+        from the scan itself."""
+        for st in self._states[1:]:
+            st["updater"].score = self._row_at(st, t)
+        if with_train:
+            st = self._states[0]
+            st["updater"].score = self._row_at(st, t)
+
+    def finalize(self):
+        """After a COMPLETED walk (no early-stop break): restore the
+        train score to the scan's final value, or — after a natural
+        stop (an empty tree mid-block) — rebuild exact state for the
+        kept trees, including partial-class trees the walk never saw."""
+        gb = self._gbdt
+        if not self._natural_stop:
+            gb.train_score_updater.score = self._scan_final_train
+            return False
+        Log.info("Stopped training because there are no more leafs "
+                 "that meet the split requirements.")
+        if gb._natural_stop_score_exact():
+            gb.train_score_updater.score = self._scan_final_train
+        else:
+            gb._rebuild_train_score_from_models()
+        if self._k_stop > 0:
+            # the stop iteration kept classes [0, k_stop) whose deltas
+            # the per-full-iteration walk never applied
+            new_trees = gb.models[self._n_before:]
+            for st in self._states[1:]:
+                st["updater"].score = st["base"]
+                if new_trees:
+                    st["updater"].add_score_by_trees(new_trees,
+                                                     gb.num_class)
+        return True
+
+
 class GBDT:
     name = "gbdt"
 
@@ -419,19 +540,15 @@ class GBDT:
             return True
         return False
 
-    def train_many(self, num_iters, ignore_train_metrics=False):
-        """Train `num_iters` boosting iterations; uses the fused in-graph
-        scan when eligible, else the per-iteration loop. Returns True if
-        training stopped early. ignore_train_metrics runs the scan even
-        with training metrics attached (the caller prints between
-        blocks; application.py train)."""
-        if num_iters <= 0:
-            return False
-        if not self._fused_eligible(ignore_train_metrics):
-            for _ in range(num_iters):
-                if self.train_one_iter():
-                    return True
-            return False
+    def _run_fused_block(self, num_iters):
+        """Run ONE fused scan of `num_iters` iterations and append the
+        materialized trees. Returns (stacked_device, t_eff, k_stop,
+        n_before): the block's stacked tree arrays still on device (for
+        snapshot traversal), the number of full iterations kept, the
+        partial-class count at a natural stop, and the model-list length
+        before the block. The train score is set to the scan's final
+        score (which, at a natural stop, still includes discarded
+        trees — callers fix that up)."""
         fn = self._get_fused_fn(num_iters)
         learner = self.tree_learner
         # same RNG stream and consumption order as the sequential path:
@@ -468,6 +585,44 @@ class GBDT:
                 self.models.append(learner.host_out_to_tree(
                     slice_at(t_eff, k), shrink=self.shrinkage_rate))
         self.iter += t_eff
+        return stacked, t_eff, k_stop, n_before
+
+    def _natural_stop_score_exact(self):
+        """At a natural stop (an empty tree mid-block), whether the
+        scan's final score is already exact: constant in-bag weights and
+        feature masks keep gradients unchanged, so every discarded tree
+        was empty and added zero score."""
+        return (self.num_class == 1 and self._fused_inbag_fn() is None
+                and self.config.feature_fraction >= 1.0)
+
+    def _rebuild_train_score_from_models(self):
+        """Recompute the train score from the kept model list (used when
+        a natural stop discards scan iterations whose score
+        contributions were not zero)."""
+        self.train_score_updater = ScoreUpdater(self.train_data,
+                                                self.num_class)
+        # skip merged/loaded init trees: the fresh updater's init
+        # score already covers them (reset_training_data replays the
+        # same range)
+        first = self.num_init_iteration * self.num_class
+        for idx in range(first, len(self.models)):
+            self.train_score_updater.add_score_by_tree(
+                self.models[idx], idx % self.num_class)
+
+    def train_many(self, num_iters, ignore_train_metrics=False):
+        """Train `num_iters` boosting iterations; uses the fused in-graph
+        scan when eligible, else the per-iteration loop. Returns True if
+        training stopped early. ignore_train_metrics runs the scan even
+        with training metrics attached (the caller prints between
+        blocks; application.py train)."""
+        if num_iters <= 0:
+            return False
+        if not self._fused_eligible(ignore_train_metrics):
+            for _ in range(num_iters):
+                if self.train_one_iter():
+                    return True
+            return False
+        _, t_eff, _, n_before = self._run_fused_block(num_iters)
         # valid scores stay in sync with the model list no matter who
         # called (the scan only carries TRAIN scores): one batched
         # update per valid set for the whole block
@@ -480,29 +635,41 @@ class GBDT:
         if t_eff < num_iters:
             Log.info("Stopped training because there are no more leafs "
                      "that meet the split requirements.")
-            if (self.num_class == 1 and self._fused_inbag_fn() is None
-                    and self.config.feature_fraction >= 1.0):
-                # iterations after the first empty tree changed nothing
-                # (constant in-bag weights and feature mask: unchanged
-                # gradients keep the tree empty, and empty trees add
-                # zero score) — state is already exact
+            if self._natural_stop_score_exact():
                 return True
             # multiclass (classes after k_stop kept learning) or
             # per-iteration bag/feature sampling (a later sample can
             # split again): the scan's score includes discarded trees —
             # rebuild from the kept trees so booster state matches the
             # model list
-            self.train_score_updater = ScoreUpdater(self.train_data,
-                                                    self.num_class)
-            # skip merged/loaded init trees: the fresh updater's init
-            # score already covers them (reset_training_data replays the
-            # same range)
-            first = self.num_init_iteration * self.num_class
-            for idx in range(first, len(self.models)):
-                self.train_score_updater.add_score_by_tree(
-                    self.models[idx], idx % self.num_class)
+            self._rebuild_train_score_from_models()
             return True
         return False
+
+    def train_many_eval(self, num_iters):
+        """Fused block + per-iteration score snapshots for metric replay
+        (the engine's valid+early-stopping fast path: gbdt.cpp:210-349
+        interleaves build and eval per iteration; here the whole block
+        builds in ONE device program and the per-iteration valid/train
+        scores are reconstructed afterwards from the block's stacked
+        tree arrays by one vmapped device traversal per dataset chunk).
+
+        Returns (t_eff, snapshots). Caller contract (engine.train):
+        - walk t = 0..t_eff-1 forward, calling
+          snapshots.set_scores_at(t) before evaluating metrics;
+        - on an early-stop break at t: snapshots.set_scores_at(t,
+          with_train=True) then snapshots.drop_tail_to(t);
+        - on a completed walk: snapshots.finalize() — returns True at
+          a natural stop (an empty tree ended the block early).
+        Requires _fused_eligible(ignore_train_metrics=True)."""
+        base_train = self.train_score_updater.score
+        base_valids = [u.score for u in self.valid_score_updaters]
+        stacked, t_eff, k_stop, n_before = self._run_fused_block(num_iters)
+        snap = _BlockSnapshots(self, stacked, base_train, base_valids,
+                               t_eff, n_before, k_stop,
+                               natural_stop=t_eff < num_iters)
+        return t_eff, snap
+
 
     def rollback_one_iter(self):
         """gbdt.cpp:247-264. Indexes from the end of the model list so it

@@ -47,7 +47,8 @@ from ..ops.split import SplitParams, find_best_split, K_MIN_SCORE
 from .tree_learner import apply_tree_split, init_split_state, write_candidate
 
 
-def _partition_segment(words, ghc, perm, seg_b, seg_c, feat, thr, cat):
+def _partition_segment(words, ghc, perm, seg_b, seg_c, feat, thr, cat,
+                       decode_fn):
     """Stable-partition the segment [seg_b, seg_b+seg_c) by the split
     decision, touching only the geometric chunk bucket covering it.
 
@@ -57,6 +58,9 @@ def _partition_segment(words, ghc, perm, seg_b, seg_c, feat, thr, cat):
     slice/gather/write-back traffic is O(bucket), not O(N): ~38x less
     movement per 63-leaf tree. Chunk-cover dispatch is shared with
     segment_histograms (ops/ordered_hist.py cover_index/window_start).
+
+    decode_fn(word_slice, feat) -> the VIRTUAL feature's bin column of
+    the slice (plain unpack for unbundled data; slot decode for EFB).
 
     Returns (words, ghc, perm, n_left) with n_left counting ALL left
     rows of the segment (in-bag + out-of-bag + padding).
@@ -75,7 +79,7 @@ def _partition_segment(words, ghc, perm, seg_b, seg_c, feat, thr, cat):
             g_sl = jax.lax.dynamic_slice(ghc, (jnp.int32(0), start),
                                          (3, length))
             p_sl = jax.lax.dynamic_slice(perm, (start,), (length,))
-            col = unpack_feature(w_sl, feat)
+            col = decode_fn(w_sl, feat)
             go_left = jnp.where(cat, col == thr, col <= thr)
             dest, n_left = split_destinations(go_left, seg_b - start, seg_c)
             src = invert_permutation(dest)
@@ -100,15 +104,27 @@ def _identity(x):
 def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
                            num_bin_pf, is_cat,
                            *, num_leaves, max_bin, params: SplitParams,
-                           max_depth, f_real, hist_reduce_fn=_identity):
+                           max_depth, f_real, hist_reduce_fn=_identity,
+                           expand_fn=_identity, decode_fn=None):
     """Grow one leaf-wise tree on device over the packed-word layout.
 
     Args:
-      words: (W, N_pad) int32 packed bins, N_pad % HIST_CHUNK == 0.
+      words: (W, N_pad) int32 packed STORED bin columns,
+        N_pad % HIST_CHUNK == 0. Unbundled: stored == virtual features,
+        4 * W == the padded virtual feature count. Bundled (EFB): the
+        words pack the SLOT matrix; histograms build and cache in slot
+        space and `expand_fn`/`decode_fn` bridge to virtual features.
       grad, hess, inbag: (N_pad,) float32 (pad rows: inbag == 0).
-      feature_mask: (F_pad,) bool; num_bin_pf: (F_pad,) int32;
-      is_cat: (F_pad,) bool, F_pad == 4 * W.
+      feature_mask: (F_v,) bool; num_bin_pf: (F_v,) int32;
+      is_cat: (F_v,) bool — all VIRTUAL-feature space (== 4 * W only
+        when unbundled).
       num_leaves, max_bin, params, max_depth, f_real: static config.
+      expand_fn: stored->virtual histogram expansion for bundled
+        datasets (same hook as build_tree_device; identity otherwise).
+        Subtraction/caching stay in stored space — expansion happens
+        only at split evaluation.
+      decode_fn: (word_slice, virtual_feat) -> int32 bin column of the
+        slice; defaults to a plain word unpack (unbundled).
       hist_reduce_fn: reduction applied to every segment histogram —
         `lax.psum` over the row-shard axis for the data-parallel
         learner (the reference's histogram ReduceScatter sync point,
@@ -128,11 +144,15 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
     l = num_leaves
     b = max_bin
     f32 = jnp.float32
-    f_pad = 4 * w
-    assert f_real <= f_pad
+    s_pad = 4 * w  # STORED rows in the packed words (== padded F_v
+    #                only when unbundled)
+    if decode_fn is None:
+        def decode_fn(w_sl, feat):
+            return unpack_feature(w_sl, feat)
+        assert f_real <= s_pad
 
     def scan_leaf(hist3, sum_g, sum_h, cnt):
-        return find_best_split(hist3, sum_g, sum_h, cnt,
+        return find_best_split(expand_fn(hist3), sum_g, sum_h, cnt,
                                num_bin_pf, is_cat, feature_mask, params)
 
     g_in = grad * inbag
@@ -141,7 +161,7 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
 
     def leaf_histogram(words_c, ghc_c, begin, cnt):
         return hist_reduce_fn(
-            segment_histograms(words_c, ghc_c, begin, cnt, b, f_pad))
+            segment_histograms(words_c, ghc_c, begin, cnt, b, s_pad))
 
     # ---- root ----------------------------------------------------------
     hist_root = leaf_histogram(words, ghc0, jnp.int32(0), jnp.int32(n_pad))
@@ -159,7 +179,7 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
     state["seg_begin"] = jnp.zeros(l, dtype=jnp.int32)
     # FULL row counts (in-bag + oob + pad), not the tree's in-bag counts
     state["seg_cnt"] = jnp.zeros(l, dtype=jnp.int32).at[0].set(n_pad)
-    state["hist_cache"] = (jnp.zeros((l, f_pad, b, 3), dtype=f32)
+    state["hist_cache"] = (jnp.zeros((l, s_pad, b, 3), dtype=f32)
                            .at[0].set(hist_root))
 
     def body(i, st):
@@ -183,7 +203,7 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
             seg_c = st["seg_cnt"][best_leaf]
             st["words"], st["ghc"], st["perm"], n_left = _partition_segment(
                 st["words"], st["ghc"], st["perm"], seg_b, seg_c,
-                feat, thr, is_cat[feat])
+                feat, thr, is_cat[feat], decode_fn)
             st["seg_begin"] = st["seg_begin"].at[right_id].set(seg_b + n_left)
             st["seg_cnt"] = (st["seg_cnt"].at[best_leaf].set(n_left)
                              .at[right_id].set(seg_c - n_left))

@@ -63,6 +63,25 @@ def _traverse_add(score_row, bins_dev, is_cat, split_feature, threshold_bin,
 _traverse_add_jit = jax.jit(_traverse_add)
 
 
+@jax.jit
+def _stacked_deltas(bins_dev, is_cat, sf, thr, lc, rc, lv, nsp, scale,
+                    feat_slot, feat_off, feat_nb):
+    """(M, ...) stacked tree arrays -> (M, N) scaled score deltas.
+
+    One vmapped bin-space traversal over the tree axis: the whole
+    block's valid/train scoring is a single device program (the
+    reference re-walks the dataset per tree inside the training loop,
+    gbdt.cpp:210-245 + tree.h:211-224)."""
+    zero = jnp.zeros((bins_dev.shape[1],), jnp.float32)
+
+    def one(sfi, thri, lci, rci, lvi, nspi):
+        return _traverse_add(zero, bins_dev, is_cat, sfi, thri, lci, rci,
+                             lvi.astype(jnp.float32), nspi, scale,
+                             feat_slot, feat_off, feat_nb)
+
+    return jax.vmap(one)(sf, thr, lc, rc, lv, nsp)
+
+
 class ScoreUpdater:
     def __init__(self, dataset, num_class):
         self.dataset = dataset
@@ -117,6 +136,22 @@ class ScoreUpdater:
             out["n_splits"], jnp.float32(scale),
             feat_slot, feat_off, feat_nb)
         self.score = self.score.at[curr_class].set(new_row)
+
+    def deltas_by_stacked_device_trees(self, stk, scale):
+        """(M, N) scaled deltas for M stacked builder-output trees (the
+        dict's arrays carry a flattened leading tree axis). Device-only;
+        no host sync. Used by GBDT.train_many_eval's per-iteration
+        score snapshots."""
+        if self._is_cat_dev is None:
+            self._is_cat_dev = jnp.asarray(
+                self.dataset.feature_is_categorical())
+        feat_slot, feat_off, feat_nb = self._decode_maps()
+        return _stacked_deltas(
+            self.dataset.device_bins(), self._is_cat_dev,
+            stk["split_feature"], stk["split_threshold_bin"],
+            stk["left_child"], stk["right_child"], stk["leaf_value"],
+            stk["n_splits"], jnp.float32(scale),
+            feat_slot, feat_off, feat_nb)
 
     def add_score_by_tree(self, tree, curr_class):
         """Host bin-space traversal (re-scoring loaded/materialized models)."""

@@ -362,7 +362,21 @@ class SerialTreeLearner:
         if n_pad != self.num_data:
             pad = np.zeros((bins.shape[0], n_pad - self.num_data), dtype=bins.dtype)
             bins = np.concatenate([bins, pad], axis=1)
-        f_pad = self._pad_feature_count(self.num_features)
+        if self._bundle is not None and self._use_partitioned:
+            # bundled + partitioned: the packed words carry the STORED
+            # slot matrix (padded to the packer's 4-per-word alignment)
+            # while the split scan stays in VIRTUAL feature space via
+            # the expand/decode hooks — so the virtual arrays
+            # (num_bin_pf / is_cat / feature masks) are NOT padded
+            s_rows = bins.shape[0]
+            s_pad = ((s_rows + 3) // 4) * 4
+            if s_pad != s_rows:
+                bins = np.concatenate(
+                    [bins, np.zeros((s_pad - s_rows, bins.shape[1]),
+                                    dtype=bins.dtype)], axis=0)
+            f_pad = self.num_features
+        else:
+            f_pad = self._pad_feature_count(self.num_features)
         self.f_pad = f_pad
         num_bin_pf = train_set.num_bin_array()
         is_cat = train_set.feature_is_categorical()
@@ -405,9 +419,11 @@ class SerialTreeLearner:
 
     def _partitioned_enabled(self, cfg):
         """Leaf-contiguous builder (models/partitioned.py): "auto"
-        turns it on for TPU backends. Needs an unbundled dataset
-        (bundling's expand/decode hooks are only wired into the masked
-        builder) and uint8-storable bins."""
+        turns it on for TPU backends. Bundled (EFB) datasets run it
+        too — the packed words carry the slot matrix and the bundle's
+        expand/decode hooks bridge to virtual features. Needs
+        uint8-storable bins (<= 256 stored bins per slot, which EFB's
+        MAX_SLOT_BINS already guarantees for bundles)."""
         mode = _partitioned_mode(cfg)
         if not self.partitioned_capable:
             if mode == "true":
@@ -417,12 +433,11 @@ class SerialTreeLearner:
             return False
         if mode == "false":
             return False
-        eligible = (self._bundle is None
-                    and int(self.train_set.max_stored_bin) <= 256)
+        eligible = int(self.train_set.max_stored_bin) <= 256
         if mode == "true":
             if not eligible:
-                Log.warning("partitioned_build=true ignored: needs an "
-                            "unbundled dataset and max_bin <= 256")
+                Log.warning("partitioned_build=true ignored: needs "
+                            "max_bin <= 256")
             return eligible
         return eligible and jax.default_backend() == "tpu"
 
@@ -467,34 +482,69 @@ class SerialTreeLearner:
         """Leaf values as a process-local array (overridden multi-host)."""
         return out["leaf_value"]
 
+    def _bundle_expand_fn(self):
+        """Stored->virtual histogram expansion closure (io/bundling.py
+        expansion_maps). Slices the histogram to the REAL slot count
+        first: the partitioned layout pads stored rows to the packer's
+        alignment, and a pad slot's bin-0 cell holds row totals — the
+        gather's zero-pad index must land past the real slots only."""
+        src = self._bundle_src
+        slot_of = self._bundle_slot_of
+        num_slots = int(self._bundle.num_slots)
+
+        def expand(h):
+            k = h.shape[-1]
+            hs = h[:num_slots]
+            flat = jnp.concatenate(
+                [hs.reshape(-1, k), jnp.zeros((1, k), h.dtype)], axis=0)
+            hv = jnp.take(flat, src, axis=0)                 # (F, B_v, 3)
+            slot_tot = jnp.sum(hs, axis=1)                   # (S, 3)
+            hv0 = (jnp.take(slot_tot, slot_of, axis=0)
+                   - jnp.sum(hv[:, 1:, :], axis=1))
+            return hv.at[:, 0, :].set(hv0)
+
+        return expand
+
+    def _bundle_window(self, sc, feat, num_bin_pf):
+        """Stored slot column -> virtual feature's bin values: member
+        `feat` owns the window (off, off + nb - 1]; anything outside it
+        (another member's bins, or slot bin 0) is the member's bin 0.
+        THE decode rule — every stored->virtual column path (masked
+        split_col, partitioned decode) must share it."""
+        off = self._bundle_feat_off[feat]
+        nb = num_bin_pf[feat]
+        return jnp.where((sc > off) & (sc <= off + nb - 1), sc - off, 0)
+
     def _bundle_kwargs(self, bins, num_bin_pf):
         """Bundled-dataset hooks for build_tree_device: stored->virtual
         histogram expansion + slot-decoding split columns. Shared with
         the row-sharded parallel learners (parallel/learners.py)."""
         if getattr(self, "_bundle", None) is None:
             return {}
-        src = self._bundle_src
-        slot_of = self._bundle_slot_of
         fslot = self._bundle_feat_slot
-        foff = self._bundle_feat_off
 
         def split_col(feat):
             sc = jnp.take(bins, fslot[feat], axis=0).astype(jnp.int32)
-            off = foff[feat]
-            nb = num_bin_pf[feat]
-            return jnp.where((sc > off) & (sc <= off + nb - 1), sc - off, 0)
+            return self._bundle_window(sc, feat, num_bin_pf)
 
-        def expand(h):
-            k = h.shape[-1]
-            flat = jnp.concatenate(
-                [h.reshape(-1, k), jnp.zeros((1, k), h.dtype)], axis=0)
-            hv = jnp.take(flat, src, axis=0)                 # (F, B_v, 3)
-            slot_tot = jnp.sum(h, axis=1)                    # (S, 3)
-            hv0 = (jnp.take(slot_tot, slot_of, axis=0)
-                   - jnp.sum(hv[:, 1:, :], axis=1))
-            return hv.at[:, 0, :].set(hv0)
+        return {"expand_fn": self._bundle_expand_fn(),
+                "split_col_fn": split_col}
 
-        return {"expand_fn": expand, "split_col_fn": split_col}
+    def _bundle_partitioned_kwargs(self, num_bin_pf):
+        """Bundled-dataset hooks for build_tree_partitioned: the same
+        histogram expansion, plus a word-slice slot decode for the
+        segment partition step (ordered_sparse_bin.hpp:25-133 is the
+        reference's leaf-grouped sparse analog)."""
+        if getattr(self, "_bundle", None) is None:
+            return {}
+        from ..ops.ordered_hist import unpack_feature
+        fslot = self._bundle_feat_slot
+
+        def decode(w_sl, feat):
+            return self._bundle_window(unpack_feature(w_sl, fslot[feat]),
+                                       feat, num_bin_pf)
+
+        return {"expand_fn": self._bundle_expand_fn(), "decode_fn": decode}
 
     def _make_build_core(self, cfg, chunk):
         """The un-jitted builder closure — also consumed directly by the
@@ -502,7 +552,7 @@ class SerialTreeLearner:
         embeds it inside its own scanned program."""
         if self._use_partitioned:
             from .partitioned import build_tree_partitioned
-            return functools.partial(
+            base_p = functools.partial(
                 build_tree_partitioned,
                 num_leaves=int(cfg.num_leaves),
                 max_bin=self.max_bin,
@@ -510,6 +560,15 @@ class SerialTreeLearner:
                 max_depth=int(cfg.max_depth),
                 f_real=self.num_features,
             )
+            if getattr(self, "_bundle", None) is None:
+                return base_p
+
+            def bundled_p(words, grad, hess, inbag, fmask, num_bin_pf,
+                          is_cat):
+                return base_p(words, grad, hess, inbag, fmask,
+                              num_bin_pf, is_cat,
+                              **self._bundle_partitioned_kwargs(num_bin_pf))
+            return bundled_p
         base = functools.partial(
             build_tree_device,
             num_leaves=int(cfg.num_leaves),

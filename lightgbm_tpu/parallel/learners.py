@@ -262,7 +262,8 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
                     words, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                     num_leaves=num_leaves, max_bin=max_bin, params=params,
                     max_depth=max_depth, f_real=f_real,
-                    hist_reduce_fn=psum)
+                    hist_reduce_fn=psum,
+                    **self._bundle_partitioned_kwargs(num_bin_pf))
 
             return jax.shard_map(
                 dp_part_fn, mesh=self.mesh,

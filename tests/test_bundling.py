@@ -35,12 +35,13 @@ def sparse_data():
     return x, y
 
 
-def _train(x, y, enable_sparse, learner="serial", rounds=6):
+def _train(x, y, enable_sparse, learner="serial", rounds=6,
+           partitioned="false"):
     cfg = Config.from_params({
         "objective": "binary", "num_leaves": 15, "min_data_in_leaf": 10,
         "num_iterations": rounds, "metric_freq": 0,
         "is_enable_sparse": enable_sparse, "tree_learner": learner,
-        "device_row_chunk": 512,
+        "device_row_chunk": 512, "partitioned_build": partitioned,
     })
     ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
     obj = create_objective(cfg.objective, cfg)
@@ -86,6 +87,26 @@ def test_bundled_data_parallel(sparse_data):
         np.testing.assert_array_equal(t1.split_feature_real,
                                       t2.split_feature_real)
         np.testing.assert_array_equal(t1.threshold_in_bin, t2.threshold_in_bin)
+
+
+def test_bundled_data_parallel_partitioned(sparse_data):
+    """Row-sharded leaf-contiguous builder on a BUNDLED dataset: every
+    shard packs slot words, psum-reduces slot-space segment histograms,
+    and splits via the expand/decode hooks — trees must match the
+    serial partitioned learner (up to its documented f32 psum order)."""
+    x, y = sparse_data
+    b1, _ = _train(x, y, enable_sparse=True, learner="serial",
+                   partitioned="true")
+    assert b1.tree_learner._use_partitioned
+    assert b1.tree_learner._bundle is not None
+    b2, _ = _train(x, y, enable_sparse=True, learner="data",
+                   partitioned="true")
+    assert b2.tree_learner._use_partitioned
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature_real,
+                                      t2.split_feature_real)
+        np.testing.assert_array_equal(t1.threshold_in_bin,
+                                      t2.threshold_in_bin)
 
 
 def test_bundled_train_set_as_valid_set(sparse_data):

@@ -120,3 +120,125 @@ def test_cv():
     assert len(res["l1-mean"]) == 20
     # CV score should improve over rounds
     assert res["l1-mean"][-1] < res["l1-mean"][0]
+
+
+# --------------------------------------------------------- blockwise fused
+# engine.train's valid+early-stopping fast path (_train_blockwise): the
+# whole block builds as one device program and the per-iteration callback
+# protocol (eval history, print cadence, early stop, evals_result) is
+# replayed from device score snapshots. Reference protocol being matched:
+# src/boosting/gbdt.cpp:210-349 interleaves build and eval per iteration.
+
+def _blockwise_pair(params, nbr=40, esr=5, seed=11, feval=None):
+    """Train the same problem twice: forced per-iteration (a user no-op
+    callback disables the blockwise path) vs blockwise. Returns both
+    (booster, evals_result) pairs."""
+    rng = np.random.RandomState(seed)
+    n = 3000
+    x = rng.randn(n, 10)
+    y = (x[:, 0] + 0.5 * rng.randn(n) > 0).astype(float)
+    xv = rng.randn(900, 10)
+    yv = (xv[:, 0] + 0.5 * rng.randn(900) > 0).astype(float)
+
+    out = []
+    for force_periter in (True, False):
+        dtr = lgb.Dataset(x, y)
+        dva = lgb.Dataset(xv, yv, reference=dtr)
+        ev = {}
+        cbs = [lambda env: None] if force_periter else None
+        b = lgb.train(dict(params), dtr, num_boost_round=nbr,
+                      valid_sets=[dtr, dva], valid_names=["tr", "va"],
+                      early_stopping_rounds=esr, evals_result=ev,
+                      verbose_eval=False, callbacks=cbs, feval=feval)
+        out.append((b, ev))
+    return out
+
+
+def test_blockwise_identical_to_per_iteration():
+    params = {"objective": "binary", "metric": ["auc", "binary_logloss"],
+              "num_leaves": 15, "verbose": -1, "feature_fraction": 0.7,
+              "bagging_fraction": 0.8, "bagging_freq": 2}
+    (b1, e1), (b2, e2) = _blockwise_pair(params)
+    # identical models, stop round, and full metric history
+    assert b1.gbdt.save_model_to_string() == b2.gbdt.save_model_to_string()
+    assert b1.best_iteration == b2.best_iteration
+    for dname in ("tr", "va"):
+        for mname in e1[dname]:
+            h1, h2 = e1[dname][mname], e2[dname][mname]
+            assert len(h1) == len(h2)
+            np.testing.assert_allclose(h1, h2, atol=1e-9)
+    # early stopping actually engaged (history shorter than the budget)
+    assert len(e1["va"]["auc"]) < 40
+
+
+def test_blockwise_feval_replay():
+    """Custom feval runs inside the replay (it reads the snapshot
+    scores), so its history must match the per-iteration path too."""
+    def err_rate(preds, data):
+        y = data.get_label()
+        return "err", float(np.mean((preds > 0.5) != (y > 0.5))), False
+
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 7, "verbose": -1}
+    (b1, e1), (b2, e2) = _blockwise_pair(params, nbr=15, esr=6,
+                                         feval=err_rate)
+    assert b1.best_iteration == b2.best_iteration
+    np.testing.assert_allclose(e1["va"]["err"], e2["va"]["err"], atol=1e-12)
+    assert len(e1["va"]["err"]) == len(e2["va"]["err"])
+
+
+def test_blockwise_no_early_stop_runs_full_budget():
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 7,
+              "verbose": -1}
+    rng = np.random.RandomState(3)
+    x = rng.randn(1500, 6)
+    y = (x[:, 0] > 0).astype(float)
+    xv = rng.randn(400, 6)
+    yv = (xv[:, 0] > 0).astype(float)
+    dtr = lgb.Dataset(x, y)
+    dva = lgb.Dataset(xv, yv, reference=dtr)
+    ev = {}
+    b = lgb.train(params, dtr, num_boost_round=12, valid_sets=[dva],
+                  evals_result=ev, verbose_eval=False)
+    assert len(ev["valid_0"]["auc"]) == 12
+    assert b.best_iteration == 12
+
+
+def test_blockwise_natural_stop_matches_per_iteration():
+    """Mid-run natural stop (split gains decay below min_gain_to_split):
+    the reference python API ignores update()'s is-finished flag and
+    keeps evaluating, so evals_result must run the full budget with
+    repeated values — in BOTH paths, with identical models."""
+    rng = np.random.RandomState(5)
+    n = 500
+    x = (rng.rand(n, 2) > 0.5).astype(np.float64)
+    y = (x[:, 0] > 0.5).astype(float)
+    xv, yv = x[:100].copy(), y[:100].copy()
+
+    # calibrate: gains decay geometrically; stop after ~3 iterations
+    dtr = lgb.Dataset(x, y)
+    probe = lgb.train({"objective": "binary", "verbose": -1,
+                       "num_leaves": 4}, dtr, num_boost_round=6)
+    gains = [float(t.split_gain[0]) for t in probe.gbdt.models]
+    assert gains == sorted(gains, reverse=True)
+    min_gain = (gains[2] + gains[3]) / 2.0
+
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 4, "verbose": -1,
+              "min_gain_to_split": min_gain}
+    res = []
+    for force_periter in (True, False):
+        dtr = lgb.Dataset(x, y)
+        dva = lgb.Dataset(xv, yv, reference=dtr)
+        ev = {}
+        cbs = [lambda env: None] if force_periter else None
+        b = lgb.train(params, dtr, num_boost_round=8, valid_sets=[dva],
+                      evals_result=ev, verbose_eval=False, callbacks=cbs)
+        (mname,) = ev["valid_0"].keys()  # display name ("logloss")
+        res.append((b.gbdt.save_model_to_string(), ev["valid_0"][mname]))
+    (m1, h1), (m2, h2) = res
+    assert m1 == m2
+    assert len(h1) == len(h2) == 8
+    np.testing.assert_allclose(h1, h2, atol=1e-12)
+    # the stop really happened mid-budget: trailing evals are constant
+    assert h1[-1] == h1[4]
