@@ -226,7 +226,10 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     _mark(f"generating {n_rows} rows")
     x, y = make_data(n_rows)
     _mark("constructing dataset (host binning + device put)")
+    t0 = time.time()
     ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    load_s = time.time() - t0
+    _mark(f"dataset constructed in {load_s:.2f}s")
     del x
 
     objective = create_objective(cfg.objective, cfg)
@@ -266,7 +269,7 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     auc_metric = create_metric("auc", cfg)
     auc_metric.init(ds.metadata, ds.num_data)
     auc = float(auc_metric.eval(booster.get_training_score())[0])
-    return train_s, auc
+    return train_s, auc, booster, load_s
 
 
 def run_child():
@@ -290,11 +293,25 @@ def run_child():
         jax.config.update("jax_platforms", "cpu")
     n_rows = int(os.environ["BENCH_CHILD_ROWS"])
     n_iters = int(os.environ.get("BENCH_CHILD_ITERS", NUM_ITERATIONS))
-    train_s, auc = train_once(n_rows, n_iters)
+    train_s, auc, booster, load_s = train_once(n_rows, n_iters)
+    # the TRAIN result prints FIRST: the optional predict timing below
+    # must not be able to cost us the primary measurement (watchdog)
     print("CHILD_RESULT " + json.dumps(
         {"time_s": round(train_s, 3), "auc": round(auc, 5),
-         "n_rows": n_rows, "n_iters": n_iters,
+         "n_rows": n_rows, "n_iters": n_iters, "load_s": round(load_s, 3),
          "platform": jax.devices()[0].platform}), flush=True)
+    if not os.environ.get("BENCH_SKIP_PREDICT"):
+        # batch prediction over the full matrix (device traversal above
+        # GBDT.DEVICE_PREDICT_CELLS; reference predictor.hpp:82-130)
+        _mark("regenerating raw matrix for predict timing")
+        x2, _ = make_data(n_rows)
+        _mark(f"predicting {n_rows} rows x {len(booster.models)} trees")
+        t0 = time.time()
+        booster.predict(x2)
+        predict_s = time.time() - t0
+        _mark(f"predict done in {predict_s:.2f}s")
+        print("CHILD_PREDICT " + json.dumps(
+            {"predict_s": round(predict_s, 3)}), flush=True)
 
 
 def measure(n_rows, n_iters, timeout_s, force_cpu=False,
@@ -329,9 +346,14 @@ def measure(n_rows, n_iters, timeout_s, force_cpu=False,
             capture_output=True, text=True, timeout=timeout_s, env=env)
     except subprocess.TimeoutExpired:
         return None, f"timeout >{timeout_s}s"
+    res = None
     for line in r.stdout.splitlines():
         if line.startswith("CHILD_RESULT "):
-            return json.loads(line.split(" ", 1)[1]), "ok"
+            res = json.loads(line.split(" ", 1)[1])
+        elif line.startswith("CHILD_PREDICT ") and res is not None:
+            res.update(json.loads(line.split(" ", 1)[1]))
+    if res is not None:
+        return res, "ok"
     tail = ((r.stderr or "") + (r.stdout or ""))[-250:].replace("\n", " ")
     return None, f"rc={r.returncode}: {tail}"
 
@@ -416,6 +438,10 @@ def _format_result(res, reason):
             result["full_workload"] = f"{N_ROWS}x28x{NUM_ITERATIONS}iter"
     else:
         result["vs_baseline"] = 0.0
+    if "load_s" in res:
+        result["load_s"] = res["load_s"]
+    if "predict_s" in res:
+        result["predict_s"] = res["predict_s"]
     if "error" in res:
         result["error"] = res["error"]
     if "fallback_from" in res:

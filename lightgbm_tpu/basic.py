@@ -146,7 +146,13 @@ class Dataset:
             else:
                 self._core = loader.load_from_file(self.data)
         else:
-            mat = _coerce_2d(self.data)
+            # column sources (CscColumns from the C API's sparse inputs)
+            # pass through untouched: one column densifies at a time.
+            # NOT a bare hasattr(.col) test — scipy COO matrices have a
+            # `.col` ndarray and must keep densifying via _coerce_2d.
+            from .io.dataset import is_column_source
+            mat = (self.data if is_column_source(self.data)
+                   else _coerce_2d(self.data))
             self._core = loader.construct_from_matrix(
                 mat, label=self.label, reference=ref_core,
                 categorical_features=categorical)

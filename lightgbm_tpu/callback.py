@@ -1,9 +1,17 @@
 """Training callbacks.
 
-Reference: python-package/lightgbm/callback.py:6-192. Same callback
-contract: callables taking a `CallbackEnv`, ordered by `.order`, run
-before each iteration when `.before_iteration` is set, else after;
-`early_stopping` signals by raising `EarlyStopException`.
+Reference CONTRACT being kept (python-package/lightgbm/callback.py:6-192,
+relied on by the reference's own tests and user code): callables taking
+a `CallbackEnv` namedtuple with these exact fields, ordered by `.order`
+(print=10, record=20, early-stop=30), run before each iteration when
+`.before_iteration` is set and after it otherwise; `early_stopping`
+signals by raising `EarlyStopException(best_iteration)`; console lines
+keep LightGBM's `[n]\\tdata's metric:value` shape.
+
+The implementation below is callback-objects rather than the
+reference's closure style: each factory returns a small stateful class
+instance whose `__call__` is the callback. State lives in attributes
+(inspectable, picklable-ish) instead of captured dicts.
 """
 
 import collections
@@ -23,113 +31,141 @@ CallbackEnv = collections.namedtuple(
      "evaluation_result_list"])
 
 
-def _format_eval_result(value, show_stdv=True):
-    """4-tuple (data, name, value, bigger_better) or 5-tuple (+std)."""
-    if len(value) == 4:
-        return "%s's %s:%g" % (value[0], value[1], value[2])
-    if len(value) == 5:
-        if show_stdv:
-            return "%s's %s:%g+%g" % (value[0], value[1], value[2], value[4])
-        return "%s's %s:%g" % (value[0], value[1], value[2])
-    raise ValueError("Wrong metric value")
+def _entry_to_text(entry, with_stdv=True):
+    """One evaluation entry -> console text. Entries are 4-tuples
+    (data, metric, value, bigger_better) from train() and 5-tuples
+    (+stdv) from cv()."""
+    if len(entry) == 4:
+        data_name, metric_name, value = entry[0], entry[1], entry[2]
+        return f"{data_name}'s {metric_name}:{value:g}"
+    if len(entry) == 5:
+        data_name, metric_name, value, _, stdv = entry
+        if with_stdv:
+            return f"{data_name}'s {metric_name}:{value:g}+{stdv:g}"
+        return f"{data_name}'s {metric_name}:{value:g}"
+    raise ValueError(
+        f"evaluation entries must be 4- or 5-tuples, got {len(entry)}")
+
+
+class _PrintEvaluation:
+    def __init__(self, period, show_stdv):
+        # instance attrs, not class attrs: engine._configure_callbacks
+        # setdefaults 'order' into user callbacks' __dict__
+        self.order = 10
+        self.period = period
+        self.show_stdv = show_stdv
+
+    def __call__(self, env):
+        if self.period <= 0 or not env.evaluation_result_list:
+            return
+        done = env.iteration + 1
+        if done % self.period:
+            return
+        line = "\t".join(_entry_to_text(e, self.show_stdv)
+                         for e in env.evaluation_result_list)
+        print(f"[{done}]\t{line}")
 
 
 def print_evaluation(period=1, show_stdv=True):
-    """Print evaluation results every `period` iterations (callback.py:40-65)."""
+    """Print evaluation results every `period` iterations
+    (callback.py:40-65)."""
+    return _PrintEvaluation(period, show_stdv)
 
-    def callback(env):
-        if not env.evaluation_result_list or period <= 0:
-            return
-        if (env.iteration + 1) % period == 0:
-            result = "\t".join(_format_eval_result(x, show_stdv)
-                               for x in env.evaluation_result_list)
-            print("[%d]\t%s" % (env.iteration + 1, result))
-    callback.order = 10
-    return callback
+
+class _RecordEvaluation:
+    def __init__(self, target):
+        self.order = 20
+        self.target = target
+
+    def __call__(self, env):
+        for data_name, metric_name, value, *_ in env.evaluation_result_list:
+            history = self.target.setdefault(
+                data_name, collections.defaultdict(list))
+            history[metric_name].append(value)
 
 
 def record_evaluation(eval_result):
-    """Record evaluation history into `eval_result` dict (callback.py:68-97)."""
+    """Record evaluation history into `eval_result` dict
+    (callback.py:68-97)."""
     if not isinstance(eval_result, dict):
-        raise TypeError("Eval_result should be a dictionary")
+        raise TypeError(
+            "record_evaluation needs a dict to write history into, got "
+            + type(eval_result).__name__)
     eval_result.clear()
+    return _RecordEvaluation(eval_result)
 
-    def init(env):
-        for item in env.evaluation_result_list:
-            eval_result.setdefault(item[0], collections.defaultdict(list))
 
-    def callback(env):
-        if not eval_result:
-            init(env)
-        # items are 4-tuples from train() and 5-tuples (+stdv) from cv()
-        for item in env.evaluation_result_list:
-            eval_result[item[0]][item[1]].append(item[2])
-    callback.order = 20
-    return callback
+class _ResetParameter:
+    def __init__(self, schedules):
+        self.order = 10
+        self.before_iteration = True
+        self.schedules = schedules
+
+    def __call__(self, env):
+        round_idx = env.iteration - env.begin_iteration
+        n_rounds = env.end_iteration - env.begin_iteration
+        new_params = {}
+        for name, schedule in self.schedules.items():
+            if isinstance(schedule, list):
+                if len(schedule) != n_rounds:
+                    raise ValueError(
+                        f"the {name!r} schedule list must have exactly "
+                        f"num_boost_round (= {n_rounds}) entries")
+                new_params[name] = schedule[round_idx]
+            else:
+                new_params[name] = schedule(round_idx)
+        for name, value in new_params.items():
+            env.model.reset_parameter({name: value})
 
 
 def reset_parameter(**kwargs):
-    """Reset parameters (e.g. learning_rate schedules) before each
-    iteration (callback.py:100-129). Values are lists (indexed by round)
-    or functions of the current round."""
+    """Per-round parameter schedules (e.g. learning_rate decay), applied
+    before each iteration (callback.py:100-129). Values are lists
+    (indexed by round) or callables of the round index."""
+    return _ResetParameter(kwargs)
 
-    def callback(env):
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(
-                        "Length of list {} has to equal to 'num_boost_round'."
-                        .format(repr(key)))
-                env.model.reset_parameter(
-                    {key: value[env.iteration - env.begin_iteration]})
-            else:
-                env.model.reset_parameter(
-                    {key: value(env.iteration - env.begin_iteration)})
-    callback.before_iteration = True
-    callback.order = 10
-    return callback
+
+class _EarlyStopping:
+    def __init__(self, patience, verbose):
+        self.order = 30
+        self.patience = patience
+        self.verbose = verbose
+        self.trackers = None  # per-metric [sign, best_score, best_it, msg]
+
+    def _start(self, env):
+        if not env.evaluation_result_list:
+            raise ValueError("early stopping needs at least one validation "
+                             "dataset and metric to watch")
+        if self.verbose:
+            print("Train until valid scores didn't improve in "
+                  f"{self.patience} rounds.")
+        self.trackers = [
+            [1.0 if entry[3] else -1.0, float("-inf"), 0, ""]
+            for entry in env.evaluation_result_list]
+
+    def __call__(self, env):
+        if self.trackers is None:
+            self._start(env)
+        for tracker, entry in zip(self.trackers, env.evaluation_result_list):
+            sign, best, best_it, _ = tracker
+            score = sign * entry[2]
+            if score > best:
+                tracker[1] = score
+                tracker[2] = env.iteration
+                if self.verbose:
+                    line = "\t".join(_entry_to_text(e)
+                                     for e in env.evaluation_result_list)
+                    tracker[3] = f"[{env.iteration + 1}]\t{line}"
+            elif env.iteration - best_it >= self.patience:
+                if env.model is not None:
+                    env.model.set_attr(best_iteration=str(best_it))
+                if self.verbose:
+                    print("Early stopping, best iteration is:")
+                    print(tracker[3])
+                raise EarlyStopException(best_it)
 
 
 def early_stopping(stopping_rounds, verbose=True):
     """Stop when no validation metric improved in `stopping_rounds`
-    rounds (callback.py:132-192). Checks ALL metrics of all valid sets."""
-    factor_to_bigger_better = {}
-    best_score = {}
-    best_iter = {}
-    best_msg = {}
-
-    def init(env):
-        if not env.evaluation_result_list:
-            raise ValueError("For early stopping, at least one dataset or "
-                             "eval metric is required for evaluation")
-        if verbose:
-            print("Train until valid scores didn't improve in {} rounds."
-                  .format(stopping_rounds))
-        for i, ret in enumerate(env.evaluation_result_list):
-            best_score[i] = float("-inf")
-            best_iter[i] = 0
-            best_msg[i] = ""
-            factor_to_bigger_better[i] = 1.0 if ret[3] else -1.0
-
-    def callback(env):
-        if not best_score:
-            init(env)
-        for i, ret in enumerate(env.evaluation_result_list):
-            score = ret[2] * factor_to_bigger_better[i]
-            if score > best_score[i]:
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                if verbose:
-                    best_msg[i] = "[%d]\t%s" % (
-                        env.iteration + 1,
-                        "\t".join(_format_eval_result(x)
-                                  for x in env.evaluation_result_list))
-            elif env.iteration - best_iter[i] >= stopping_rounds:
-                if env.model is not None:
-                    env.model.set_attr(best_iteration=str(best_iter[i]))
-                if verbose:
-                    print("Early stopping, best iteration is:")
-                    print(best_msg[i])
-                raise EarlyStopException(best_iter[i])
-    callback.order = 30
-    return callback
+    rounds; checks ALL metrics of all valid sets (callback.py:132-192)."""
+    return _EarlyStopping(stopping_rounds, verbose)

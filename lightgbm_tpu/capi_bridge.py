@@ -110,34 +110,33 @@ def dataset_create_from_mat(data_addr, data_type, nrow, ncol, is_row_major,
 def dataset_create_from_csr(indptr_addr, indptr_type, indices_addr, data_addr,
                             data_type, nindptr, nelem, num_col, parameters,
                             reference):
+    """Sparse rows stay sparse until binning (c_api.cpp:317-376): the
+    CSR triplets transpose to a column source in O(nnz) and each column
+    densifies one at a time inside the loader."""
+    from .io.dataset import CscColumns
     indptr = _read_array(indptr_addr, indptr_type, nindptr)
     indices = _read_array(indices_addr, C_API_DTYPE_INT32, nelem)
     vals = _read_array(data_addr, data_type, nelem)
-    nrow = nindptr - 1
-    mat = np.zeros((nrow, num_col), dtype=np.float32)
-    for i in range(nrow):
-        sl = slice(indptr[i], indptr[i + 1])
-        mat[i, indices[sl]] = vals[sl]
+    src = CscColumns.from_csr(indptr, indices, vals, num_col)
     params = _params_to_dict(parameters)
     ref = reference.dataset if reference is not None else None
-    return _CDataset(Dataset(mat, reference=ref, params=params,
+    return _CDataset(Dataset(src, reference=ref, params=params,
                              free_raw_data=False))
 
 
 def dataset_create_from_csc(colptr_addr, colptr_type, indices_addr, data_addr,
                             data_type, ncolptr, nelem, num_row, parameters,
                             reference):
+    """Column-major sparse input binned without densifying
+    (c_api.cpp:378-427)."""
+    from .io.dataset import CscColumns
     colptr = _read_array(colptr_addr, colptr_type, ncolptr)
     indices = _read_array(indices_addr, C_API_DTYPE_INT32, nelem)
     vals = _read_array(data_addr, data_type, nelem)
-    ncol = ncolptr - 1
-    mat = np.zeros((num_row, ncol), dtype=np.float32)
-    for j in range(ncol):
-        sl = slice(colptr[j], colptr[j + 1])
-        mat[indices[sl], j] = vals[sl]
+    src = CscColumns(colptr, indices, vals, num_row, ncolptr - 1)
     params = _params_to_dict(parameters)
     ref = reference.dataset if reference is not None else None
-    return _CDataset(Dataset(mat, reference=ref, params=params,
+    return _CDataset(Dataset(src, reference=ref, params=params,
                              free_raw_data=False))
 
 

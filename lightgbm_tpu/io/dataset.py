@@ -62,6 +62,60 @@ class _VirtualBinsView:
         return np.where((sc > off) & (sc <= off + nb - 1), sc - off, 0)
 
 
+def is_column_source(obj):
+    """True for objects implementing the column-source protocol
+    (DenseColumns / CscColumns). A bare hasattr(obj, "col") is NOT
+    enough: scipy.sparse COO matrices carry a `.col` ndarray."""
+    return callable(getattr(obj, "col", None)) and hasattr(obj, "num_total")
+
+
+class DenseColumns:
+    """Column source over a dense (N, F) matrix (see _construct)."""
+
+    def __init__(self, mat):
+        self._m = mat
+        self.n, self.num_total = mat.shape
+
+    def col(self, j):
+        return self._m[:, j]
+
+
+class CscColumns:
+    """Column source over CSC triplets: each column materializes as ONE
+    dense (N,) f32 vector at a time, so a sparse FFI input is binned in
+    O(nnz + N) peak memory instead of the O(N * F) dense raw matrix —
+    the TPU-side analog of the reference's row-iterator dataset
+    construction (c_api.cpp:317-427)."""
+
+    def __init__(self, colptr, indices, vals, num_row, num_col):
+        self._p = np.asarray(colptr, dtype=np.int64)
+        self._i = np.asarray(indices, dtype=np.int64)
+        self._v = np.nan_to_num(np.asarray(vals, dtype=np.float32), nan=0.0)
+        self.n = int(num_row)
+        self.num_total = int(num_col)
+
+    def col(self, j):
+        out = np.zeros(self.n, dtype=np.float32)
+        sl = slice(self._p[j], self._p[j + 1])
+        out[self._i[sl]] = self._v[sl]
+        return out
+
+    @classmethod
+    def from_csr(cls, indptr, indices, vals, num_col):
+        """O(nnz log nnz) CSR -> CSC transpose (stable by row within a
+        column); never builds the dense matrix."""
+        indptr = np.asarray(indptr, dtype=np.int64)
+        indices = np.asarray(indices, dtype=np.int64)
+        vals = np.asarray(vals)
+        nrow = len(indptr) - 1
+        row_of = np.repeat(np.arange(nrow, dtype=np.int64),
+                           np.diff(indptr))
+        order = np.argsort(indices, kind="stable")
+        counts = np.bincount(indices, minlength=num_col)
+        colptr = np.concatenate([[0], np.cumsum(counts)])
+        return cls(colptr, row_of[order], vals[order], nrow, num_col)
+
+
 class CoreDataset:
     """Eagerly-binned dataset (the reference's `Dataset`, dataset.h:278-421)."""
 
@@ -387,7 +441,7 @@ class DatasetLoader:
                                          num_cols, sample_idx)
         sample_feats = sample_all[:, feat_cols]
         mappers, used_map, real_idx = self._make_mappers(
-            sample_feats, num_feats, ignore, categorical)
+            lambda j: sample_feats[:, j], num_feats, ignore, categorical)
 
         # bundling plan from the sample — identical to the in-memory
         # path's (same sample rows, same greedy pass)
@@ -435,8 +489,11 @@ class DatasetLoader:
         weights = np.empty(n_local, dtype=np.float32) if weight_idx >= 0 else None
         qid = np.empty(n_local, dtype=np.float64) if group_idx >= 0 else None
         bundle_conflicts = 0
-        for start, block in iter_blocks(filename, fmt, cfg.has_header,
-                                        num_cols):
+        # double-buffered: the prefetch thread parses block k+1 while
+        # this loop bins block k (pipeline_reader.h:18-70)
+        from .streaming import prefetch_blocks
+        for start, block in prefetch_blocks(
+                iter_blocks(filename, fmt, cfg.has_header, num_cols)):
             end = start + len(block)
             if start >= hi:
                 break  # past this rank's range: skip the rest of the file
@@ -502,7 +559,17 @@ class DatasetLoader:
     # --------------------------------------------------------- from matrix
     def construct_from_matrix(self, data, label=None, reference=None,
                               categorical_features=()) -> CoreDataset:
-        """In-memory path (c_api.cpp LGBM_DatasetCreateFromMat:268-315)."""
+        """In-memory path (c_api.cpp LGBM_DatasetCreateFromMat:268-315).
+        `data` may also be a column source (CscColumns): sparse inputs
+        bin column-by-column, never densified (c_api.cpp:317-427)."""
+        if is_column_source(data):
+            meta = Metadata(data.n)
+            if label is not None:
+                meta.set_label(label)
+            if reference is not None:
+                return self._bin_with_mappers(data, reference, meta)
+            categorical = set(int(c) for c in categorical_features)
+            return self._construct(data, None, set(), categorical, meta)
         data = np.ascontiguousarray(np.asarray(data, dtype=np.float32))
         data = np.nan_to_num(data, nan=0.0)
         meta = Metadata(data.shape[0])
@@ -553,16 +620,17 @@ class DatasetLoader:
         rnd = Random(cfg.data_random_seed)
         return rnd.sample(n, cnt).astype(np.int64)
 
-    def _make_mappers(self, sample, num_total, ignore, categorical):
+    def _make_mappers(self, sample_col, num_total, ignore, categorical):
         """Bin-mapper construction from sampled rows
-        (ConstructBinMappersFromTextData, dataset_loader.cpp:612-760)."""
+        (ConstructBinMappersFromTextData, dataset_loader.cpp:612-760).
+        `sample_col(j)` -> the j-th column's sampled values."""
         cfg = self.config
         used_map = np.full(num_total, -1, dtype=np.int32)
         mappers, real_idx = [], []
         for j in range(num_total):
             if j in ignore:
                 continue
-            col_sample = sample[:, j].astype(np.float64)
+            col_sample = sample_col(j).astype(np.float64)
             nonzero = col_sample[np.abs(col_sample) > ZERO_THRESHOLD]
             btype = CATEGORICAL if j in categorical else NUMERICAL
             m = BinMapper().find_bin(nonzero, len(col_sample), cfg.max_bin, btype)
@@ -579,11 +647,19 @@ class DatasetLoader:
 
     def _construct(self, feats, names, ignore, categorical, meta) -> CoreDataset:
         """Bin-mapper construction + feature extraction
-        (ConstructBinMappersFromTextData + ExtractFeatures, dataset_loader.cpp:612-841)."""
+        (ConstructBinMappersFromTextData + ExtractFeatures, dataset_loader.cpp:612-841).
+
+        `feats` is a dense (N, F) matrix or any column source with
+        .n / .num_total / .col(j) (sparse FFI inputs bin one column at a
+        time and never materialize the dense raw matrix, the TPU-side
+        analog of c_api.cpp:317-427's row-iterator construction)."""
         cfg = self.config
-        n, num_total = feats.shape
+        src = feats if is_column_source(feats) else DenseColumns(feats)
+        n, num_total = src.n, src.num_total
         sample_idx = self._sample_rows(n)
-        sample = feats[sample_idx]
+
+        def sample_col(j):
+            return src.col(j)[sample_idx]
 
         ds = CoreDataset()
         ds.num_total_features = num_total
@@ -591,7 +667,7 @@ class DatasetLoader:
                             else [f"Column_{i}" for i in range(num_total)])
 
         mappers, used_map, real_idx = self._make_mappers(
-            sample, num_total, ignore, categorical)
+            sample_col, num_total, ignore, categorical)
 
         # exclusive feature bundling: sparse columns share dense slots
         # (io/bundling.py; replaces the reference's sparse_bin storage)
@@ -599,7 +675,7 @@ class DatasetLoader:
         plan = None
         if cfg.is_enable_sparse and cfg.tree_learner != "feature":
             sample_bins = np.stack(
-                [mappers[used_map[j]].value_to_bin(sample[:, j])
+                [mappers[used_map[j]].value_to_bin(sample_col(j))
                  for j in real_idx], axis=0)
             plan = plan_bundles(mappers, sample_bins, enable=True)
             if plan.is_identity:
@@ -609,14 +685,14 @@ class DatasetLoader:
             dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
                      else np.uint16)
             ds.bins = np.stack(
-                [mappers[used_map[j]].value_to_bin(feats[:, j]).astype(dtype)
+                [mappers[used_map[j]].value_to_bin(src.col(j)).astype(dtype)
                  for j in real_idx], axis=0)
         else:
             dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
                      else np.uint16)
             ds.bins = build_stored_matrix(
                 plan,
-                lambda u: mappers[u].value_to_bin(feats[:, real_idx[u]]),
+                lambda u: mappers[u].value_to_bin(src.col(real_idx[u])),
                 dtype)
             ds.bundle_plan = plan
         ds.bin_mappers = mappers
@@ -627,6 +703,7 @@ class DatasetLoader:
         return ds
 
     def _bin_with_mappers(self, feats, ref_ds: CoreDataset, meta) -> CoreDataset:
+        src = feats if is_column_source(feats) else DenseColumns(feats)
         ds = CoreDataset()
         ds.num_total_features = ref_ds.num_total_features
         ds.label_idx = ref_ds.label_idx
@@ -634,9 +711,9 @@ class DatasetLoader:
         ds.bin_mappers = ref_ds.bin_mappers
         ds.used_feature_map = ref_ds.used_feature_map
         ds.real_feature_idx = ref_ds.real_feature_idx
-        if feats.shape[1] < ref_ds.num_total_features:
+        if src.num_total < ref_ds.num_total_features:
             Log.fatal("Validation data has fewer features than training data")
-        cols = [m.value_to_bin(feats[:, j]).astype(ref_ds.bins.dtype)
+        cols = [m.value_to_bin(src.col(j)).astype(ref_ds.bins.dtype)
                 for j, m in zip(ref_ds.real_feature_idx, ref_ds.bin_mappers)]
         ds.bins = np.stack(cols, axis=0)
         ds.metadata = meta

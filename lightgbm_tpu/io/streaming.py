@@ -98,14 +98,72 @@ def iter_blocks(path, fmt, has_header, num_cols, block_rows=DEFAULT_BLOCK_ROWS):
         start += len(block)
 
 
+def prefetch_blocks(block_iter, depth=2):
+    """Double-buffered block pipeline (pipeline_reader.h:18-70): a
+    producer thread runs the parse iterator (pandas' C tokenizer and
+    the numpy conversions release the GIL) while the consumer bins the
+    previous block; the bounded queue caps peak memory at `depth`
+    blocks and provides the backpressure the reference gets from its
+    two-buffer swap."""
+    import queue
+    import threading
+
+    q = queue.Queue(maxsize=depth)
+    end = object()
+    stop = threading.Event()
+    err = []
+
+    def produce():
+        try:
+            for item in block_iter:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surface parse errors in the consumer
+            err.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(end, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=produce, daemon=True, name="block-prefetch")
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+    finally:
+        # early consumer exit (rank filtering breaks mid-file): release
+        # the producer so the file handle closes promptly
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        t.join(timeout=10)
+    if err:
+        raise err[0]
+
+
 def collect_sample_rows(path, fmt, has_header, num_cols, sample_idx,
                         block_rows=DEFAULT_BLOCK_ROWS):
     """Round one: gather the (ascending) sampled row indices in one
     streaming pass (text_reader.h SampleFromFile)."""
     sample_idx = np.asarray(sample_idx, dtype=np.int64)
     out = np.empty((len(sample_idx), num_cols), dtype=np.float64)
-    for start, block in iter_blocks(path, fmt, has_header, num_cols,
-                                    block_rows):
+    for start, block in prefetch_blocks(
+            iter_blocks(path, fmt, has_header, num_cols, block_rows)):
         lo = np.searchsorted(sample_idx, start)
         hi = np.searchsorted(sample_idx, start + len(block))
         if hi > lo:

@@ -15,6 +15,9 @@ window, identical between the fused scan and the per-iteration loop,
 and exact-count like the reference's.
 """
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -69,6 +72,35 @@ class LazyTree:
         if name.startswith("_"):
             raise AttributeError(name)
         return getattr(self.materialize(), name)
+
+
+class _VersionedList(list):
+    """Model list with a mutation counter: the stacked-prediction caches
+    key on (slice, length, version) so length-preserving mutations
+    (rollback + retrain) can never serve stale trees."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.version = 0
+
+    def _bump(self):
+        self.version = getattr(self, "version", 0) + 1
+
+    def append(self, item):
+        self._bump()
+        super().append(item)
+
+    def extend(self, items):
+        self._bump()
+        super().extend(items)
+
+    def __delitem__(self, key):
+        self._bump()
+        super().__delitem__(key)
+
+    def __setitem__(self, key, value):
+        self._bump()
+        super().__setitem__(key, value)
 
 
 class _BlockSnapshots:
@@ -196,7 +228,7 @@ class GBDT:
     name = "gbdt"
 
     def __init__(self):
-        self.models = []            # list[Tree], class-major per iteration
+        self.models = _VersionedList()  # Tree list, class-major per iteration
         self.iter = 0
         self.num_init_iteration = 0
         self.num_iteration_for_pred = 0
@@ -802,7 +834,8 @@ class GBDT:
         traverses EVERY tree at once (the reference parallelizes file
         prediction across rows with OpenMP, predictor.hpp:82-130; here
         the tree axis is vectorized too). Cached per model-list state."""
-        key = (n_used, len(self.models))
+        key = (n_used, len(self.models),
+               getattr(self.models, "version", -1))
         cached = getattr(self, "_stack_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -834,17 +867,105 @@ class GBDT:
         self._stack_cache = (key, stacked)
         return stacked
 
+    # rows*trees above this run the jitted device traversal (the
+    # reference parallelizes prediction with OpenMP, predictor.hpp:82-130;
+    # here rows AND trees vectorize on device, class reduction on the MXU)
+    DEVICE_PREDICT_CELLS = 20_000_000
+    _PREDICT_BLOCK = 65_536
+
+    def _device_model(self, n_used):
+        """Stacked tree arrays placed on device (f32/int32), cached per
+        model-list state."""
+        key = (n_used, len(self.models),
+               getattr(self.models, "version", -1))
+        cached = getattr(self, "_dev_model_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        sf, thr, dt, lc, rc, lv, has_split, depth = \
+            self._stacked_model_arrays(n_used)
+        dev = (jnp.asarray(sf), jnp.asarray(thr, jnp.float32),
+               jnp.asarray(dt == Tree.CATEGORICAL),
+               jnp.asarray(lc), jnp.asarray(rc),
+               jnp.asarray(lv, jnp.float32),
+               jnp.asarray(np.where(has_split, 0, ~0).astype(np.int32)),
+               int(depth))
+        self._dev_model_cache = (key, dev)
+        return dev
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnums=(9,))
+    def _predict_block_device(xb, sf, thr, cat, lc, rc, lv, node0,
+                              cls_onehot, depth):
+        """(B, F) raw f32 rows -> (B, K) class sums: every (row, tree)
+        pair walks in lockstep for `depth` steps (leaves freeze as ~leaf
+        in the child arrays), then the per-class reduction runs as a
+        (B, T) x (T, K) matmul inside the same program (MXU). NaN:
+        numeric compares send NaN right (fval <= thr is False),
+        matching the host path."""
+        b = xb.shape[0]
+        t_cnt = sf.shape[0]
+        t_idx = jnp.arange(t_cnt)
+        node_init = jnp.broadcast_to(node0[None, :], (b, t_cnt))
+        xs = jnp.nan_to_num(xb)  # categorical compare needs a finite cast
+
+        def step(_, node):
+            nd = jnp.maximum(node, 0)
+            feat = sf[t_idx[None, :], nd]                       # (B, T)
+            th = thr[t_idx[None, :], nd]
+            is_c = cat[t_idx[None, :], nd]
+            rows = jnp.arange(b)[:, None]
+            fval = xb[rows, feat]
+            fcat = xs[rows, feat]
+            go_left = jnp.where(is_c,
+                                fcat.astype(jnp.int32) == th.astype(jnp.int32),
+                                fval <= th)
+            nxt = jnp.where(go_left, lc[t_idx[None, :], nd],
+                            rc[t_idx[None, :], nd])
+            return jnp.where(node < 0, node, nxt)
+
+        node = jax.lax.fori_loop(0, depth, step, node_init)
+        vals = lv[t_idx[None, :], ~node]                        # (B, T)
+        return vals @ cls_onehot                                # (B, K)
+
+    def _predict_raw_device(self, x, n_used):
+        """Device batch prediction: fixed-size row blocks through ONE
+        compiled traversal+reduction program. f32 thresholds/values —
+        the host path remains the f64 reference for small batches."""
+        sf, thr, cat, lc, rc, lv, node0, depth = self._device_model(n_used)
+        t_cnt = sf.shape[0]
+        cls_onehot = jnp.asarray(
+            (np.arange(t_cnt)[:, None] % self.num_class
+             == np.arange(self.num_class)[None, :]).astype(np.float32))
+        n = x.shape[0]
+        block = self._PREDICT_BLOCK
+        outs = []
+        for s in range(0, n, block):
+            xb = np.asarray(x[s:s + block], dtype=np.float32)
+            pad = block - xb.shape[0]
+            if pad:
+                xb = np.pad(xb, ((0, pad), (0, 0)))
+            outs.append(self._predict_block_device(
+                jnp.asarray(xb), sf, thr, cat, lc, rc, lv, node0,
+                cls_onehot, depth))
+        host = np.concatenate([np.asarray(o) for o in outs], axis=0)[:n]
+        return host.astype(np.float64)
+
     def predict_raw(self, x, num_iteration=-1):
         """Raw scores for (N, num_total_features) raw values -> (N, K).
 
         All trees traverse together: per depth step one (rows, trees)
-        gather instead of a Python loop over trees."""
+        gather instead of a Python loop over trees. Large batches
+        (rows x trees >= DEVICE_PREDICT_CELLS) run the jitted device
+        traversal instead of the host loop."""
         x = np.atleast_2d(np.asarray(x, dtype=np.float64))
         n_used = self._num_used_models(num_iteration)
         n = x.shape[0]
         out = np.zeros((n, self.num_class))
         if n_used == 0 or n == 0:
             return out
+        if (n * n_used >= self.DEVICE_PREDICT_CELLS
+                and os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT", "1") != "0"):
+            return self._predict_raw_device(x, n_used)
         sf, thr, dt, lc, rc, lv, has_split, depth = \
             self._stacked_model_arrays(n_used)
         t_cnt = sf.shape[0]
@@ -931,7 +1052,7 @@ class GBDT:
 
     def load_model_from_string(self, model_str):
         """gbdt.cpp:515-583."""
-        self.models = []
+        self.models = _VersionedList()
         lines = model_str.split("\n")
 
         def find_line(prefix):
@@ -996,7 +1117,7 @@ class GBDT:
 
     def merge_from(self, other):
         """Booster merge for continued training (gbdt.h:44-61)."""
-        self.models = list(other.models) + self.models
+        self.models = _VersionedList(list(other.models) + self.models)
         self.num_init_iteration += len(other.models) // max(self.num_class, 1)
 
 

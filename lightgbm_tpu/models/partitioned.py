@@ -105,7 +105,8 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
                            num_bin_pf, is_cat,
                            *, num_leaves, max_bin, params: SplitParams,
                            max_depth, f_real, hist_reduce_fn=_identity,
-                           expand_fn=_identity, decode_fn=None):
+                           expand_fn=_identity, decode_fn=None,
+                           cache_hists=True):
     """Grow one leaf-wise tree on device over the packed-word layout.
 
     Args:
@@ -125,6 +126,10 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
         only at split evaluation.
       decode_fn: (word_slice, virtual_feat) -> int32 bin column of the
         slice; defaults to a plain word unpack (unbundled).
+      cache_hists: False = memory-bounded mode (histogram_pool_size
+        exceeded): no (L, S, B, 3) cache — both children's segment
+        histograms are computed directly at each split (cost at most
+        the parent's row count instead of the smaller child's).
       hist_reduce_fn: reduction applied to every segment histogram —
         `lax.psum` over the row-shard axis for the data-parallel
         learner (the reference's histogram ReduceScatter sync point,
@@ -179,8 +184,9 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
     state["seg_begin"] = jnp.zeros(l, dtype=jnp.int32)
     # FULL row counts (in-bag + oob + pad), not the tree's in-bag counts
     state["seg_cnt"] = jnp.zeros(l, dtype=jnp.int32).at[0].set(n_pad)
-    state["hist_cache"] = (jnp.zeros((l, s_pad, b, 3), dtype=f32)
-                           .at[0].set(hist_root))
+    if cache_hists:
+        state["hist_cache"] = (jnp.zeros((l, s_pad, b, 3), dtype=f32)
+                               .at[0].set(hist_root))
 
     def body(i, st):
         best_leaf = jnp.argmax(st["best_gain"]).astype(jnp.int32)
@@ -212,19 +218,30 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
                 (pos >= seg_b + n_left) & (pos < seg_b + seg_c),
                 right_id, st["pos_leaf"])
 
-            # ---- smaller-child histogram + parent subtraction
-            # smaller side by GLOBAL in-bag count, matching the masked
-            # builder (data_parallel_tree_learner.cpp:178-187)
-            left_is_small = st["best_lc"][best_leaf] <= st["best_rc"][best_leaf]
-            small_b = jnp.where(left_is_small, seg_b, seg_b + n_left)
-            small_c = jnp.where(left_is_small, n_left, seg_c - n_left)
-            hist_small = leaf_histogram(st["words"], st["ghc"],
-                                        small_b, small_c)
-            hist_large = st["hist_cache"][best_leaf] - hist_small
-            hist_left = jnp.where(left_is_small, hist_small, hist_large)
-            hist_right = jnp.where(left_is_small, hist_large, hist_small)
-            st["hist_cache"] = (st["hist_cache"].at[best_leaf].set(hist_left)
-                                .at[right_id].set(hist_right))
+            if cache_hists:
+                # ---- smaller-child histogram + parent subtraction
+                # smaller side by GLOBAL in-bag count, matching the
+                # masked builder (data_parallel_tree_learner.cpp:178-187)
+                left_is_small = (st["best_lc"][best_leaf]
+                                 <= st["best_rc"][best_leaf])
+                small_b = jnp.where(left_is_small, seg_b, seg_b + n_left)
+                small_c = jnp.where(left_is_small, n_left, seg_c - n_left)
+                hist_small = leaf_histogram(st["words"], st["ghc"],
+                                            small_b, small_c)
+                hist_large = st["hist_cache"][best_leaf] - hist_small
+                hist_left = jnp.where(left_is_small, hist_small, hist_large)
+                hist_right = jnp.where(left_is_small, hist_large,
+                                       hist_small)
+                st["hist_cache"] = (st["hist_cache"]
+                                    .at[best_leaf].set(hist_left)
+                                    .at[right_id].set(hist_right))
+            else:
+                # memory-bounded mode: both children's segments scanned
+                hist_left = leaf_histogram(st["words"], st["ghc"],
+                                           seg_b, n_left)
+                hist_right = leaf_histogram(st["words"], st["ghc"],
+                                            seg_b + n_left,
+                                            seg_c - n_left)
 
             # ---- children leaf state (LeafSplits::Init after split)
             child_depth = st["leaf_depth"][best_leaf] + 1

@@ -169,7 +169,7 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
                       max_depth, row_chunk,
                       hist_psum_fn=_collapse_pair, sum_psum_fn=_identity,
                       evaluate_fn=None, split_col_fn=None,
-                      expand_fn=_identity):
+                      expand_fn=_identity, cache_hists=True):
     """Grow one leaf-wise tree on device. All shapes static.
 
     Args:
@@ -204,6 +204,12 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
         datasets (io/bundling.py); identity otherwise. Histograms are
         cached and subtracted in STORED space (cheap), expanded only at
         split evaluation.
+      cache_hists: keep the (L, F, B, 3) per-leaf histogram cache and
+        get the larger child by parent subtraction (the reference's
+        HistogramPool fast path). False = memory-bounded mode
+        (histogram_pool_size exceeded, feature_histogram.hpp:337-481's
+        LRU analog): both children's histograms are recomputed at each
+        split, memory O(F * B) instead of O(L * F * B).
 
     Returns a dict of tree arrays + the final row->leaf partition.
     """
@@ -249,8 +255,10 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
 
     state = init_split_state(l, root_split, root_c)
     state["row_leaf"] = row_leaf0
-    # per-leaf histogram cache (HistogramPool, fixed buffer)
-    state["hist_cache"] = jnp.zeros((l, f, b, 3), dtype=f32).at[0].set(hist_root)
+    if cache_hists:
+        # per-leaf histogram cache (HistogramPool, fixed buffer)
+        state["hist_cache"] = (jnp.zeros((l, f, b, 3), dtype=f32)
+                               .at[0].set(hist_root))
 
     def body(i, st):
         best_leaf = jnp.argmax(st["best_gain"]).astype(jnp.int32)
@@ -274,18 +282,27 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
             st["row_leaf"] = jnp.where(in_leaf & ~go_left_row, right_id,
                                        st["row_leaf"])
 
-            # ---- smaller-child histogram + parent subtraction
-            # smaller side by GLOBAL in-bag count (consistent across row
-            # shards; data_parallel_tree_learner.cpp:178-187)
-            left_is_small = st["best_lc"][best_leaf] <= st["best_rc"][best_leaf]
-            small_leaf = jnp.where(left_is_small, best_leaf, right_id)
-            hist_small = hist_psum_fn(
-                leaf_histogram(st["row_leaf"], small_leaf.astype(jnp.int32)))
-            hist_large = st["hist_cache"][best_leaf] - hist_small
-            hist_left = jnp.where(left_is_small, hist_small, hist_large)
-            hist_right = jnp.where(left_is_small, hist_large, hist_small)
-            st["hist_cache"] = (st["hist_cache"].at[best_leaf].set(hist_left)
-                                .at[right_id].set(hist_right))
+            if cache_hists:
+                # ---- smaller-child histogram + parent subtraction
+                # smaller side by GLOBAL in-bag count (consistent across
+                # row shards; data_parallel_tree_learner.cpp:178-187)
+                left_is_small = (st["best_lc"][best_leaf]
+                                 <= st["best_rc"][best_leaf])
+                small_leaf = jnp.where(left_is_small, best_leaf, right_id)
+                hist_small = hist_psum_fn(leaf_histogram(
+                    st["row_leaf"], small_leaf.astype(jnp.int32)))
+                hist_large = st["hist_cache"][best_leaf] - hist_small
+                hist_left = jnp.where(left_is_small, hist_small, hist_large)
+                hist_right = jnp.where(left_is_small, hist_large, hist_small)
+                st["hist_cache"] = (st["hist_cache"]
+                                    .at[best_leaf].set(hist_left)
+                                    .at[right_id].set(hist_right))
+            else:
+                # memory-bounded mode: both children recomputed
+                hist_left = hist_psum_fn(
+                    leaf_histogram(st["row_leaf"], best_leaf))
+                hist_right = hist_psum_fn(
+                    leaf_histogram(st["row_leaf"], right_id))
 
             # ---- children leaf state (LeafSplits::Init after split)
             child_depth = st["leaf_depth"][best_leaf] + 1
@@ -546,10 +563,38 @@ class SerialTreeLearner:
 
         return {"expand_fn": self._bundle_expand_fn(), "decode_fn": decode}
 
+    def _cache_hists(self, cfg):
+        """Whether the per-leaf histogram cache (the fixed-buffer
+        HistogramPool analog) fits the configured budget. The reference
+        LRU-pages histograms under histogram_pool_size MB
+        (feature_histogram.hpp:337-481); dynamic eviction is
+        XLA-hostile, so over budget we instead RECOMPUTE both children's
+        histograms at each split (no parent subtraction): memory drops
+        from O(num_leaves * F * B) to O(F * B), cost at most doubles."""
+        stored = self._bins.shape[0] * (4 if self._use_partitioned else 1)
+        cache_mb = (int(cfg.num_leaves) * stored * self.max_bin * 3 * 4
+                    ) / (1024.0 * 1024.0)
+        pool = float(cfg.histogram_pool_size)
+        if 0 <= pool < cache_mb:
+            Log.info("Histogram cache (%.0f MB at %d leaves x %d stored "
+                     "features x %d bins) exceeds histogram_pool_size="
+                     "%.0f MB: recomputing child histograms instead of "
+                     "caching for subtraction", cache_mb,
+                     int(cfg.num_leaves), stored, self.max_bin, pool)
+            return False
+        if pool < 0 and cache_mb > 4096:
+            Log.warning("Histogram cache needs %.0f MB of device memory "
+                        "(%d leaves x %d stored features x %d bins); set "
+                        "histogram_pool_size (MB) to cap it via "
+                        "recompute mode", cache_mb, int(cfg.num_leaves),
+                        stored, self.max_bin)
+        return True
+
     def _make_build_core(self, cfg, chunk):
         """The un-jitted builder closure — also consumed directly by the
         fused multi-iteration trainer (models/gbdt.py train_many), which
         embeds it inside its own scanned program."""
+        cache_hists = self._cache_hists(cfg)
         if self._use_partitioned:
             from .partitioned import build_tree_partitioned
             base_p = functools.partial(
@@ -559,6 +604,7 @@ class SerialTreeLearner:
                 params=self.params,
                 max_depth=int(cfg.max_depth),
                 f_real=self.num_features,
+                cache_hists=cache_hists,
             )
             if getattr(self, "_bundle", None) is None:
                 return base_p
@@ -576,6 +622,7 @@ class SerialTreeLearner:
             params=self.params,
             max_depth=int(cfg.max_depth),
             row_chunk=chunk,
+            cache_hists=cache_hists,
         )
         if getattr(self, "_bundle", None) is None:
             return base

@@ -42,7 +42,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.tree_learner import SerialTreeLearner, build_tree_device
-from ..ops.split import find_best_split, per_feature_best, split_info_at
+from ..ops.split import (K_MIN_SCORE, find_best_split, per_feature_best,
+                         split_info_at)
 from ..utils.log import Log
 
 AXIS = "data"
@@ -409,7 +410,7 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
         top_k = max(int(cfg.top_k), 1)
         f = self.num_features
         top_k = min(top_k, f)
-        sel_k = min(2 * top_k, f)
+        n_shards = self.n_shards
         # local vote constraints scaled by 1/num_machines
         # (voting_parallel_tree_learner.cpp:52-54)
         local_params = params._replace(
@@ -430,22 +431,35 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 gains, _ = per_feature_best(hist3, local_g, local_h, local_c,
                                             num_bin_pf, is_cat, fmask,
                                             local_params)
-                _, local_top = jax.lax.top_k(gains, top_k)
+                top_g, local_top = jax.lax.top_k(gains, top_k)
+                # GlobalVoting (:137-166): every machine's local top-k
+                # candidates, re-scored by the WEIGHTED gain
+                # gain * local_leaf_count / mean_leaf_count; per feature
+                # keep the best; the global candidate set is the top-k
+                # features by that score (lax.top_k's lowest-index tie
+                # order plays ArrayArgs::MaxK's stable partial sort)
+                w = local_c * (n_shards / jnp.maximum(cnt, 1.0))
+                top_wg = jnp.where(jnp.isfinite(top_g), top_g * w,
+                                   K_MIN_SCORE)
                 all_top = jax.lax.all_gather(local_top, AXIS).reshape(-1)
-                votes = jnp.zeros(f, jnp.float32).at[all_top].add(1.0)
-                # global top-2k by votes; tie-break smaller feature id
-                # (ArrayArgs::MaxK + vote count, :137-166)
-                rank_key = votes * (2.0 * f) - jnp.arange(f, dtype=jnp.float32)
-                _, selected = jax.lax.top_k(rank_key, sel_k)
+                all_wg = jax.lax.all_gather(top_wg, AXIS).reshape(-1)
+                feature_best = (jnp.full(f, K_MIN_SCORE, jnp.float32)
+                                .at[all_top].max(all_wg))
+                _, selected = jax.lax.top_k(feature_best, top_k)
                 selected = jnp.sort(selected)
+                # a feature nobody voted for must not win on its global
+                # histogram (the reference never aggregates it at all)
+                voted = jnp.isfinite(jnp.take(feature_best, selected))
                 # selective reduction: psum ONLY the voted features'
-                # histograms (the analog of the <=2k-feature ReduceScatter)
+                # histograms (the analog of the <=2k-feature ReduceScatter,
+                # CopyLocalHistogram :167-230)
                 hist_sel = psum(jnp.take(hist3, selected, axis=0))
                 gains_sel, thr_sel = per_feature_best(
                     hist_sel, sum_g, sum_h, cnt,
                     jnp.take(num_bin_pf, selected),
                     jnp.take(is_cat, selected),
                     jnp.take(fmask, selected), params)
+                gains_sel = jnp.where(voted, gains_sel, K_MIN_SCORE)
                 best_local = jnp.argmax(gains_sel).astype(jnp.int32)
                 sp = split_info_at(hist_sel, sum_g, sum_h, cnt,
                                    jnp.take(is_cat, selected), params,

@@ -30,6 +30,13 @@ static thread_local std::string g_last_error = "Everything is fine";
 
 DllExport const char* LGBM_GetLastError() { return g_last_error.c_str(); }
 
+/* c_api.h:554-556 keeps this inline for in-process use; exporting it
+ * lets FFI hosts stamp their own error text into the same thread-local
+ * slot GetLastError reads. */
+DllExport void LGBM_SetLastError(const char* msg) {
+  g_last_error = msg ? msg : "";
+}
+
 namespace {
 
 PyObject* g_bridge = nullptr;

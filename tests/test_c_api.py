@@ -233,3 +233,44 @@ def test_error_reporting(lib):
         ctypes.byref(handle))
     assert ret == -1
     assert len(lib.LGBM_GetLastError()) > 0
+
+
+def test_set_last_error_export(lib):
+    """c_api.h:554-556's error setter is exported so FFI hosts can stamp
+    error text into the thread-local slot GetLastError reads."""
+    lib.LGBM_SetLastError(_c_str("custom ffi error"))
+    assert lib.LGBM_GetLastError().decode() == "custom ffi error"
+    lib.LGBM_SetLastError(_c_str("Everything is fine"))
+
+
+def test_csr_binning_matches_dense():
+    """The sparse C-API path bins via a column source (never the dense
+    raw matrix, c_api.cpp:317-427) — the resulting CoreDataset must be
+    bit-identical to dense construction of the same logical matrix."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import CscColumns, DatasetLoader
+
+    rng = np.random.RandomState(11)
+    n, f = 800, 12
+    dense = rng.rand(n, f).astype(np.float64)
+    dense[rng.rand(n, f) < 0.85] = 0.0      # genuinely sparse
+    # CSR triplets of the same matrix
+    indptr = [0]
+    indices, vals = [], []
+    for i in range(n):
+        nz = np.nonzero(dense[i])[0]
+        indices.extend(nz.tolist())
+        vals.extend(dense[i, nz].tolist())
+        indptr.append(len(indices))
+    src = CscColumns.from_csr(np.asarray(indptr), np.asarray(indices),
+                              np.asarray(vals, dtype=np.float64), f)
+    y = (dense[:, 0] > 0).astype(np.float32)
+    cfg = Config.from_params({"objective": "binary", "max_bin": 31,
+                              "verbose": -1})
+    ds_dense = DatasetLoader(cfg).construct_from_matrix(
+        dense.astype(np.float32), label=y)
+    ds_sparse = DatasetLoader(cfg).construct_from_matrix(src, label=y)
+    np.testing.assert_array_equal(ds_dense.bins, ds_sparse.bins)
+    assert len(ds_dense.bin_mappers) == len(ds_sparse.bin_mappers)
+    for ma, mb in zip(ds_dense.bin_mappers, ds_sparse.bin_mappers):
+        np.testing.assert_array_equal(ma.bin_upper_bound, mb.bin_upper_bound)

@@ -242,3 +242,74 @@ def test_blockwise_natural_stop_matches_per_iteration():
     np.testing.assert_allclose(h1, h2, atol=1e-12)
     # the stop really happened mid-budget: trailing evals are constant
     assert h1[-1] == h1[4]
+
+
+def test_device_predict_matches_host():
+    """Large-batch prediction runs a jitted device traversal
+    (predictor.hpp:82-130 is the reference's OpenMP analog); it must
+    match the host f64 path, including NaN routing and multiclass."""
+    rng = np.random.RandomState(21)
+    for params, make_y in (
+        ({"objective": "binary", "num_leaves": 15}, 
+         lambda x: (x[:, 0] + 0.3 * rng.randn(len(x)) > 0).astype(float)),
+        ({"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+          "min_data_in_leaf": 5},
+         lambda x: ((x[:, 0] > 0).astype(int) + (x[:, 1] > 0.5).astype(int)).astype(float)),
+    ):
+        x = rng.randn(2500, 8)
+        y = make_y(x)
+        dtr = lgb.Dataset(x, y)
+        b = lgb.train(dict(params, verbose=-1), dtr, num_boost_round=10)
+        xq = rng.randn(500, 8)
+        xq[::17, 3] = np.nan
+        host = b.gbdt.predict_raw(xq)            # below threshold: host path
+        gb = b.gbdt
+        old = gb.DEVICE_PREDICT_CELLS
+        try:
+            gb.DEVICE_PREDICT_CELLS = 1          # force device path
+            dev = gb.predict_raw(xq)
+        finally:
+            gb.DEVICE_PREDICT_CELLS = old
+        np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+
+
+def test_predict_cache_invalidated_by_rollback():
+    """Stacked-prediction caches key on the model list's mutation
+    version: rollback + retrain at the same length must not serve the
+    replaced tree."""
+    rng = np.random.RandomState(31)
+    x = rng.randn(1500, 6)
+    y = (x[:, 0] + 0.3 * rng.randn(1500) > 0).astype(float)
+    dtr = lgb.Dataset(x, y)
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                   "bagging_fraction": 0.7, "bagging_freq": 1}, dtr,
+                  num_boost_round=5)
+    gb = b.gbdt
+    xq = rng.randn(200, 6)
+    p_before = gb.predict_raw(xq)          # populates the stack cache
+    gb.rollback_one_iter()
+    gb.shrinkage_rate *= 0.5               # retrained tree clearly differs
+    gb.train_one_iter(is_eval=False)
+    p_after = gb.predict_raw(xq)
+    assert len(gb.models) == 5
+    assert not np.allclose(p_before, p_after)
+    # and the fresh prediction matches a cache-free recomputation
+    gb._stack_cache = None
+    gb._dev_model_cache = None
+    np.testing.assert_allclose(gb.predict_raw(xq), p_after, atol=1e-12)
+
+
+def test_scipy_coo_input_still_densifies():
+    """scipy COO matrices carry a `.col` ndarray — they must keep going
+    through the dense coercion, not the column-source protocol."""
+    sparse = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(33)
+    dense = rng.rand(600, 5)
+    dense[rng.rand(600, 5) < 0.7] = 0.0
+    y = (dense[:, 0] > 0).astype(float)
+    coo = sparse.coo_matrix(dense)
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(coo, y), num_boost_round=3)
+    b2 = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                   lgb.Dataset(dense, y), num_boost_round=3)
+    assert b.gbdt.save_model_to_string() == b2.gbdt.save_model_to_string()

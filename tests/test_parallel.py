@@ -139,3 +139,51 @@ def test_data_parallel_auto_keeps_masked():
         "verbose": -1, "metric_freq": 0})
     g = _train(cfg, X, y, rounds=2)
     assert not g.tree_learner._use_partitioned
+
+
+def test_voting_semantics_hand_computable():
+    """Pin the PV-Tree vote protocol against GlobalVoting
+    (voting_parallel_tree_learner.cpp:137-166): each machine nominates
+    its local top-k features; the global candidate set is the top-k of
+    those by WEIGHTED gain (gain * local_leaf_count / mean_count), ties
+    to the smaller feature id; only candidates' histograms are reduced.
+
+    Construction (2 machines, rows split at n/2):
+      f0: perfect label match on machine A, constant 0 on machine B
+      f1: constant 0 on A, perfect match on B          (mirror of f0)
+      f2: 98% match on BOTH machines -> the best GLOBAL split
+    Machine A's local best is f0, B's is f1 — so with top_k=1 the voted
+    set is {f0} (f0/f1 weighted gains are exactly equal by symmetry;
+    smaller id wins) and the root MUST split on f0 even though f2 is
+    globally better; with top_k=3 f2 enters the candidate set and wins,
+    matching the serial learner. That asymmetry is the signature of the
+    reference's voting protocol — a votes-only or global-gain scheme
+    would pick differently in one of the two cases."""
+    n = 1024
+    half = n // 2
+    i = np.arange(n)
+    y = (i % 2).astype(np.float32)
+    flip = (i % 50 == 0)          # 2% disagreement for f2
+    f0 = np.where(i < half, y, 0.0)
+    f1 = np.where(i < half, 0.0, y)
+    f2 = np.where(flip, 1.0 - y, y)
+    x = np.stack([f0, f1, f2], axis=1).astype(np.float32)
+
+    def cfg(learner, top_k=1):
+        return Config(objective="binary", num_leaves=2, num_machines=2,
+                      min_data_in_leaf=10, tree_learner=learner,
+                      verbose=-1, top_k=top_k, device_row_chunk=half)
+
+    g_serial = _train(cfg("serial"), x, y, rounds=1)
+    assert int(g_serial.models[0].split_feature_real[0]) == 2
+
+    g_vote1 = _train(cfg("voting", top_k=1), x, y, rounds=1)
+    assert int(g_vote1.models[0].split_feature_real[0]) == 0
+
+    g_vote3 = _train(cfg("voting", top_k=3), x, y, rounds=1)
+    assert int(g_vote3.models[0].split_feature_real[0]) == 2
+    # and with every feature voted, the selective reduction must yield
+    # the serial split exactly (same threshold, same leaf values)
+    ts, tv = g_serial.models[0], g_vote3.models[0]
+    np.testing.assert_array_equal(ts.threshold_in_bin, tv.threshold_in_bin)
+    np.testing.assert_allclose(ts.leaf_value, tv.leaf_value, rtol=1e-5)

@@ -80,3 +80,31 @@ def test_sample_is_uniform_ordered():
     assert list(Random(1).sample(5, 5)) == [0, 1, 2, 3, 4]
     assert len(Random(1).sample(5, 0)) == 0
     assert len(Random(1).sample(3, 7)) == 0  # k > n -> empty (random.h:57)
+
+
+def test_prefetch_blocks_matches_direct():
+    """The double-buffered pipeline (pipeline_reader.h:18-70) must yield
+    exactly the direct iterator's blocks, propagate producer errors, and
+    release the producer on early consumer exit."""
+    from lightgbm_tpu.io.streaming import prefetch_blocks
+
+    blocks = [(i * 10, np.full((10, 3), i, dtype=np.float64))
+              for i in range(7)]
+    got = list(prefetch_blocks(iter(blocks), depth=2))
+    assert len(got) == 7
+    for (s1, b1), (s2, b2) in zip(blocks, got):
+        assert s1 == s2
+        np.testing.assert_array_equal(b1, b2)
+
+    # early exit: take 2 of 7, generator must close cleanly
+    gen = prefetch_blocks(iter(blocks), depth=2)
+    assert next(gen)[0] == 0
+    assert next(gen)[0] == 10
+    gen.close()
+
+    # producer errors surface in the consumer
+    def boom():
+        yield 0, np.zeros((1, 1))
+        raise RuntimeError("parse failed")
+    with pytest.raises(RuntimeError, match="parse failed"):
+        list(prefetch_blocks(boom(), depth=2))
