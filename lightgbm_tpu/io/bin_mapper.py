@@ -114,7 +114,13 @@ class BinMapper:
         """Vectorized value->bin (bin.h:353-375). Returns int32 bins."""
         values = np.asarray(values)
         if self.bin_type == NUMERICAL:
-            v = np.nan_to_num(values.astype(np.float64), nan=0.0)
+            v = np.asarray(values, dtype=np.float64)
+            # NaN must bin to 0 (bin.h NaN->zero-bin); ±inf lands in the
+            # edge bins with or without cleaning, so the (copying)
+            # nan_to_num pass only runs when NaNs actually exist — the
+            # 11M HIGGS load calls this 28 times on pre-cleaned columns
+            if np.isnan(v).any():
+                v = np.nan_to_num(v, nan=0.0)
             return np.searchsorted(self.bin_upper_bound, v, side="left").astype(np.int32)
         if self._cat_lookup is None:
             self._cat_lookup = {int(c): i for i, c in enumerate(self.bin_2_categorical)}

@@ -872,6 +872,8 @@ class GBDT:
     # here rows AND trees vectorize on device, class reduction on the MXU)
     DEVICE_PREDICT_CELLS = 20_000_000
     _PREDICT_BLOCK = 65_536
+    # host-path (rows x trees) cells per traversal block (peak memory)
+    _HOST_TRAVERSE_CELLS = 4_000_000
 
     def _device_model(self, n_used):
         """Stacked tree arrays placed on device (f32/int32), cached per
@@ -966,37 +968,46 @@ class GBDT:
         if (n * n_used >= self.DEVICE_PREDICT_CELLS
                 and os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT", "1") != "0"):
             return self._predict_raw_device(x, n_used)
+        lv = self._stacked_model_arrays(n_used)[5]
+        t_cnt = lv.shape[0]
+        t_idx = np.arange(t_cnt)
+        cls = t_idx % self.num_class       # class-major model list
+        block = max(1, min(n, self._HOST_TRAVERSE_CELLS // max(t_cnt, 1)))
+        for s in range(0, n, block):
+            node = self._traverse_host(x[s:s + block], n_used)   # (b, T)
+            vals = lv[t_idx[None, :], ~node]                     # (b, T)
+            for k in range(self.num_class):
+                out[s:s + block, k] = vals[:, cls == k].sum(axis=1)
+        return out
+
+    def _traverse_host(self, xb, n_used):
+        """Host traversal of one row block through all stacked trees:
+        returns the final (b, T) node states (~leaf encoded). Shared by
+        predict_raw's host path and predict_leaf_index."""
         sf, thr, dt, lc, rc, lv, has_split, depth = \
             self._stacked_model_arrays(n_used)
         t_cnt = sf.shape[0]
         t_idx = np.arange(t_cnt)
-        block = max(1, min(n, 4_000_000 // max(t_cnt, 1)))
-        for s in range(0, n, block):
-            xb = x[s:s + block]
-            xbs = np.nan_to_num(xb)  # per block: keeps peak memory O(block)
-            node = np.where(has_split[None, :], 0, ~0).astype(np.int32)
-            node = np.broadcast_to(node, (len(xb), t_cnt)).copy()
-            for _ in range(depth):
-                active = node >= 0
-                if not active.any():
-                    break
-                nd = np.maximum(node, 0)
-                feat = sf[t_idx[None, :], nd]
-                th = thr[t_idx[None, :], nd]
-                d = dt[t_idx[None, :], nd]
-                fval = xb[np.arange(len(xb))[:, None], feat]
-                fcat = xbs[np.arange(len(xb))[:, None], feat]
-                go_left = np.where(d == Tree.CATEGORICAL,
-                                   fcat.astype(np.int64) == th.astype(np.int64),
-                                   fval <= th)
-                nxt = np.where(go_left, lc[t_idx[None, :], nd],
-                               rc[t_idx[None, :], nd])
-                node = np.where(active, nxt, node)
-            vals = lv[t_idx[None, :], ~node]                     # (b, T)
-            cls = t_idx % self.num_class   # class-major model list
-            for k in range(self.num_class):
-                out[s:s + block, k] = vals[:, cls == k].sum(axis=1)
-        return out
+        xbs = np.nan_to_num(xb)  # finite cast for the categorical compare
+        node = np.where(has_split[None, :], 0, ~0).astype(np.int32)
+        node = np.broadcast_to(node, (len(xb), t_cnt)).copy()
+        for _ in range(depth):
+            active = node >= 0
+            if not active.any():
+                break
+            nd = np.maximum(node, 0)
+            feat = sf[t_idx[None, :], nd]
+            th = thr[t_idx[None, :], nd]
+            d = dt[t_idx[None, :], nd]
+            fval = xb[np.arange(len(xb))[:, None], feat]
+            fcat = xbs[np.arange(len(xb))[:, None], feat]
+            go_left = np.where(d == Tree.CATEGORICAL,
+                               fcat.astype(np.int64) == th.astype(np.int64),
+                               fval <= th)
+            nxt = np.where(go_left, lc[t_idx[None, :], nd],
+                           rc[t_idx[None, :], nd])
+            node = np.where(active, nxt, node)
+        return node
 
     def predict(self, x, num_iteration=-1):
         """gbdt.cpp:622-636: sigmoid/softmax-transformed predictions."""
@@ -1008,9 +1019,20 @@ class GBDT:
         return raw
 
     def predict_leaf_index(self, x, num_iteration=-1):
+        """(N, T) leaf indices via the same all-trees host traversal as
+        predict_raw (the reference runs this OpenMP-parallel per row,
+        predictor.hpp:108-118)."""
         x = np.atleast_2d(np.asarray(x, dtype=np.float64))
         n_used = self._num_used_models(num_iteration)
-        return np.stack([self.models[i].get_leaf(x) for i in range(n_used)], axis=1)
+        n = x.shape[0]
+        if n_used == 0 or n == 0:
+            return np.zeros((n, 0), dtype=np.int32)
+        block = max(1, min(n, self._HOST_TRAVERSE_CELLS // n_used))
+        outs = []
+        for s in range(0, n, block):
+            node = self._traverse_host(x[s:s + block], n_used)
+            outs.append((~node).astype(np.int32))
+        return np.concatenate(outs, axis=0)
 
     # --------------------------------------------------------- serialization
     def feature_importance(self):

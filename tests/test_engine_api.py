@@ -313,3 +313,20 @@ def test_scipy_coo_input_still_densifies():
     b2 = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
                    lgb.Dataset(dense, y), num_boost_round=3)
     assert b.gbdt.save_model_to_string() == b2.gbdt.save_model_to_string()
+
+
+def test_pred_leaf_matches_per_tree_traversal():
+    """predict(pred_leaf=True) uses the all-trees vectorized traversal;
+    it must equal the per-tree Tree.get_leaf reference, including NaN
+    routing and 0-split trees."""
+    rng = np.random.RandomState(41)
+    x = rng.randn(600, 5)
+    y = (x[:, 0] > 0).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(x, y), 4)
+    xq = rng.randn(150, 5)
+    xq[::13, 1] = np.nan
+    li = b.predict(xq, pred_leaf=True)
+    ref = np.stack([b.gbdt.models[i].get_leaf(np.atleast_2d(xq))
+                    for i in range(len(b.gbdt.models))], axis=1)
+    np.testing.assert_array_equal(li, ref)

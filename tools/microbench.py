@@ -147,6 +147,8 @@ def main():
     # the partition step at several segment sizes (the second hot op of
     # the partitioned builder: slice + stable partition + write-back)
     from lightgbm_tpu.models.partitioned import _partition_segment
+    from lightgbm_tpu.ops.ordered_hist import unpack_feature
+
     perm0 = jnp.arange(n_pad, dtype=jnp.int32)
     for seg in [HIST_CHUNK, 16 * HIST_CHUNK, n_pad]:
         seg = min(seg, n_pad)
@@ -158,11 +160,42 @@ def main():
             w2, g2, p2, nl = _partition_segment(
                 w, g, p, jnp.int32(0), jnp.int32(seg),
                 jnp.int32(3), jnp.int32(100) + (p[0] % 2),
-                jnp.asarray(False))
+                jnp.asarray(False), unpack_feature)
             return (w2, g2, p2)
 
         chain_time(part_step, (words28, ghc_t, perm0), k,
                    f"partition seg={seg}")
+
+    # ---- the ACTUAL bench unit: one full fused boosting iteration
+    # (gradients + whole partitioned tree + score update) at the bench
+    # config — chain-timed so s/iter reads off directly on the tunnel
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    n_real = min(n_pad, 1_000_000)
+    xr = rng.randn(n_real, 28).astype(np.float32)
+    yr = (xr[:, 0] > 0).astype(np.float32)
+    for part in ("true", "false"):
+        cfg = Config.from_params({
+            "objective": "binary", "num_leaves": 63, "max_bin": 255,
+            "num_iterations": k, "metric_freq": 0, "verbose": -1,
+            "partitioned_build": part})
+        ds = DatasetLoader(cfg).construct_from_matrix(xr, label=yr)
+        obj = create_objective(cfg.objective, cfg)
+        obj.init(ds.metadata, ds.num_data)
+        b = GBDT()
+        b.init(cfg, ds, obj, [])
+        if not b.warm_up_fused(k):
+            print(f"fused_iter part={part}: ineligible, skipped")
+            continue
+        t0 = time.time()
+        b.train_many(k)
+        np.asarray(b.get_training_score())
+        dt = (time.time() - t0) / k
+        name = "partitioned" if part == "true" else "masked"
+        print(f"fused_iter {name} {n_real}x28x63l: {dt * 1e3:9.2f} ms/iter")
 
 
 if __name__ == "__main__":
