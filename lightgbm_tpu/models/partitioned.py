@@ -106,7 +106,8 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
                            *, num_leaves, max_bin, params: SplitParams,
                            max_depth, f_real, hist_reduce_fn=_identity,
                            expand_fn=_identity, decode_fn=None,
-                           cache_hists=True):
+                           cache_hists=True, evaluate_fn=None,
+                           sum_psum_fn=_identity):
     """Grow one leaf-wise tree on device over the packed-word layout.
 
     Args:
@@ -130,6 +131,12 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
         exceeded): no (L, S, B, 3) cache — both children's segment
         histograms are computed directly at each split (cost at most
         the parent's row count instead of the smaller child's).
+      evaluate_fn: optional (hist3, sum_g, sum_h, cnt) -> SplitInfo
+        override, same contract as build_tree_device's: the voting
+        learner keeps hist_reduce_fn=identity (LOCAL histograms) and
+        does its own selective reduction here.
+      sum_psum_fn: reduces the scalar root sums across row shards
+        (identity whenever hist_reduce_fn already globalized them).
       hist_reduce_fn: reduction applied to every segment histogram —
         `lax.psum` over the row-shard axis for the data-parallel
         learner (the reference's histogram ReduceScatter sync point,
@@ -156,9 +163,14 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
             return unpack_feature(w_sl, feat)
         assert f_real <= s_pad
 
+    if evaluate_fn is None:
+        def evaluate_fn(hist3, sum_g, sum_h, cnt):
+            return find_best_split(hist3, sum_g, sum_h, cnt,
+                                   num_bin_pf, is_cat, feature_mask,
+                                   params)
+
     def scan_leaf(hist3, sum_g, sum_h, cnt):
-        return find_best_split(expand_fn(hist3), sum_g, sum_h, cnt,
-                               num_bin_pf, is_cat, feature_mask, params)
+        return evaluate_fn(expand_fn(hist3), sum_g, sum_h, cnt)
 
     g_in = grad * inbag
     h_in = hess * inbag
@@ -171,9 +183,9 @@ def build_tree_partitioned(words, grad, hess, inbag, feature_mask,
     # ---- root ----------------------------------------------------------
     hist_root = leaf_histogram(words, ghc0, jnp.int32(0), jnp.int32(n_pad))
     # root sums from the histogram: feature 0's bins partition the rows
-    root_g = jnp.sum(hist_root[0, :, 0])
-    root_h = jnp.sum(hist_root[0, :, 1])
-    root_c = jnp.sum(hist_root[0, :, 2])
+    root_g = sum_psum_fn(jnp.sum(hist_root[0, :, 0]))
+    root_h = sum_psum_fn(jnp.sum(hist_root[0, :, 1]))
+    root_c = sum_psum_fn(jnp.sum(hist_root[0, :, 2]))
     root_split = scan_leaf(hist_root, root_g, root_h, root_c)
 
     state = init_split_state(l, root_split, root_c)

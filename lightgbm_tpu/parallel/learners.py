@@ -110,9 +110,18 @@ class _MeshedTreeLearner(SerialTreeLearner):
     # which input axes are sharded: "rows" or "features"
     shard_rows = True
     shard_features = False
-    # only the data-parallel learner re-enables the leaf-contiguous
-    # builder (per-shard layouts + one psum per histogram)
+    # the row-sharded learners re-enable the leaf-contiguous builder
+    # (per-shard layouts + collectives at the evaluation points)
     partitioned_capable = False
+
+    def _partitioned_enabled(self, cfg):
+        # EXPLICIT opt-in only for meshed learners ("auto" keeps the
+        # masked builder: the data-parallel default must preserve the
+        # reference's exact serial == parallel tree guarantee)
+        from ..models.tree_learner import _partitioned_mode
+        if _partitioned_mode(cfg) != "true":
+            return False
+        return super()._partitioned_enabled(cfg)
 
     def init(self, train_set):
         self.mesh = make_mesh(self.config)
@@ -237,15 +246,6 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
     shard_rows = True
     partitioned_capable = True
 
-    def _partitioned_enabled(self, cfg):
-        # EXPLICIT opt-in only ("auto" keeps masked + Kahan
-        # pair-allreduce): the default must preserve the reference's
-        # exact serial == data-parallel tree guarantee
-        from ..models.tree_learner import _partitioned_mode
-        if _partitioned_mode(cfg) != "true":
-            return False
-        return super()._partitioned_enabled(cfg)
-
     def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
         max_bin = self.max_bin
@@ -256,6 +256,7 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
             from ..models.partitioned import build_tree_partitioned
             f_real = self.num_features
             psum = functools.partial(jax.lax.psum, axis_name=AXIS)
+            cache_hists = self._cache_hists(cfg)
 
             def dp_part_fn(words, grad, hess, inbag, fmask, num_bin_pf,
                            is_cat):
@@ -263,7 +264,7 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
                     words, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                     num_leaves=num_leaves, max_bin=max_bin, params=params,
                     max_depth=max_depth, f_real=f_real,
-                    hist_reduce_fn=psum,
+                    hist_reduce_fn=psum, cache_hists=cache_hists,
                     **self._bundle_partitioned_kwargs(num_bin_pf))
 
             return jax.shard_map(
@@ -401,6 +402,7 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
     the top-voted features' histograms are globally reduced."""
     name = "voting"
     shard_rows = True
+    partitioned_capable = True
 
     def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
@@ -418,7 +420,10 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
             min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / self.n_shards)
         psum = functools.partial(jax.lax.psum, axis_name=AXIS)
 
-        def voting_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
+        def make_evaluate(fmask, num_bin_pf, is_cat):
+            """The vote-and-selectively-reduce split evaluation, shared
+            by the masked and leaf-contiguous cores (both feed it the
+            LOCAL histogram — hist_reduce stays identity)."""
             def evaluate(hist3, sum_g, sum_h, cnt):
                 # local per-feature best gains from LOCAL leaf sums (the
                 # reference votes on machine-local smaller_leaf_splits_,
@@ -467,11 +472,36 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                                    gains_sel[best_local])
                 return sp._replace(feature=selected[best_local])
 
+            return evaluate
+
+        if self._use_partitioned:
+            from ..models.partitioned import build_tree_partitioned
+            f_real = self.num_features
+            cache_hists = self._cache_hists(cfg)
+
+            def voting_part_fn(words, grad, hess, inbag, fmask,
+                               num_bin_pf, is_cat):
+                return build_tree_partitioned(
+                    words, grad, hess, inbag, fmask, num_bin_pf, is_cat,
+                    num_leaves=num_leaves, max_bin=max_bin, params=params,
+                    max_depth=max_depth, f_real=f_real,
+                    sum_psum_fn=psum, cache_hists=cache_hists,
+                    evaluate_fn=make_evaluate(fmask, num_bin_pf, is_cat),
+                    **self._bundle_partitioned_kwargs(num_bin_pf))
+
+            return jax.shard_map(
+                voting_part_fn, mesh=self.mesh,
+                in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
+                          P(None), P(None), P(None)),
+                out_specs=self._out_specs(), check_vma=False)
+
+        def voting_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
             return build_tree_device(
                 bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
-                sum_psum_fn=psum, evaluate_fn=evaluate,
+                sum_psum_fn=psum,
+                evaluate_fn=make_evaluate(fmask, num_bin_pf, is_cat),
                 **self._bundle_kwargs(bins, num_bin_pf))
 
         return jax.shard_map(

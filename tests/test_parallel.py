@@ -187,3 +187,39 @@ def test_voting_semantics_hand_computable():
     ts, tv = g_serial.models[0], g_vote3.models[0]
     np.testing.assert_array_equal(ts.threshold_in_bin, tv.threshold_in_bin)
     np.testing.assert_allclose(ts.leaf_value, tv.leaf_value, rtol=1e-5)
+
+
+def test_voting_partitioned_same_vote_protocol():
+    """The leaf-contiguous voting core (partitioned_build=true) runs the
+    SAME vote-and-selectively-reduce evaluation — on the construction of
+    test_voting_semantics_hand_computable it must take identical root
+    splits at both top_k settings.
+
+    Machine blocks are HIST_CHUNK-sized here: the partitioned layout
+    pads each shard to HIST_CHUNK multiples, so smaller datasets would
+    re-chunk across the 2-device mesh and "machine A/B" would no longer
+    line up with the construction (vote outcomes depend on row
+    placement by design — PV-Tree is distribution-sensitive; the
+    data-parallel learner stays exact regardless via its psum)."""
+    from lightgbm_tpu.ops.pallas_hist import HIST_CHUNK
+    n = 2 * HIST_CHUNK
+    half = n // 2
+    i = np.arange(n)
+    y = (i % 2).astype(np.float32)
+    flip = (i % 50 == 0)
+    f0 = np.where(i < half, y, 0.0)
+    f1 = np.where(i < half, 0.0, y)
+    f2 = np.where(flip, 1.0 - y, y)
+    x = np.stack([f0, f1, f2], axis=1).astype(np.float32)
+
+    def cfg(top_k):
+        return Config(objective="binary", num_leaves=2, num_machines=2,
+                      min_data_in_leaf=10, tree_learner="voting",
+                      verbose=-1, top_k=top_k, device_row_chunk=half,
+                      partitioned_build="true")
+
+    g1 = _train(cfg(1), x, y, rounds=1)
+    assert g1.tree_learner._use_partitioned
+    assert int(g1.models[0].split_feature_real[0]) == 0
+    g3 = _train(cfg(3), x, y, rounds=1)
+    assert int(g3.models[0].split_feature_real[0]) == 2
