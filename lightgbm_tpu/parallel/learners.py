@@ -170,6 +170,16 @@ class _MeshedTreeLearner(SerialTreeLearner):
         k = self.n_shards
         return ((f + k - 1) // k) * k
 
+    def _row_sharded_map(self, fn):
+        """The row-sharded learners' common shard_map shape: bins/words
+        replicated-by-feature x row-sharded, per-row arrays row-sharded,
+        per-feature arrays replicated."""
+        return jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
+                      P(None), P(None), P(None)),
+            out_specs=self._out_specs(), check_vma=False)
+
     def _bins_sharding(self):
         if self.shard_features:
             return NamedSharding(self.mesh, P(AXIS, None))
@@ -267,11 +277,7 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
                     hist_reduce_fn=psum, cache_hists=cache_hists,
                     **self._bundle_partitioned_kwargs(num_bin_pf))
 
-            return jax.shard_map(
-                dp_part_fn, mesh=self.mesh,
-                in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
-                          P(None), P(None), P(None)),
-                out_specs=self._out_specs(), check_vma=False)
+            return self._row_sharded_map(dp_part_fn)
 
         def dp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
             # hist pair-allreduce already yields the GLOBAL histogram on
@@ -284,11 +290,7 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
                 hist_psum_fn=pair_allreduce,
                 **self._bundle_kwargs(bins, num_bin_pf))
 
-        return jax.shard_map(
-            dp_fn, mesh=self.mesh,
-            in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
-                      P(None), P(None), P(None)),
-            out_specs=self._out_specs(), check_vma=False)
+        return self._row_sharded_map(dp_fn)
 
 
 class FeatureParallelTreeLearner(_MeshedTreeLearner):
@@ -489,11 +491,7 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                     evaluate_fn=make_evaluate(fmask, num_bin_pf, is_cat),
                     **self._bundle_partitioned_kwargs(num_bin_pf))
 
-            return jax.shard_map(
-                voting_part_fn, mesh=self.mesh,
-                in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
-                          P(None), P(None), P(None)),
-                out_specs=self._out_specs(), check_vma=False)
+            return self._row_sharded_map(voting_part_fn)
 
         def voting_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
             return build_tree_device(
@@ -504,8 +502,4 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 evaluate_fn=make_evaluate(fmask, num_bin_pf, is_cat),
                 **self._bundle_kwargs(bins, num_bin_pf))
 
-        return jax.shard_map(
-            voting_fn, mesh=self.mesh,
-            in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
-                      P(None), P(None), P(None)),
-            out_specs=self._out_specs(), check_vma=False)
+        return self._row_sharded_map(voting_fn)

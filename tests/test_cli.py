@@ -147,3 +147,21 @@ def test_block_fused_matches_sequential_metrics(tmp_path, capsys):
     # the %g strings
     np.testing.assert_allclose(values(fused_lines), values(seq_lines),
                                rtol=1e-5)
+
+
+def test_multiclass_example_conf(tmp_path):
+    """examples/multiclass_classification: 5-class softmax with
+    training+valid multi_logloss (early stopping and metric cadence are
+    disabled here to keep the run short — the CLI early-stop path is
+    covered by test_block_fused_matches_sequential_metrics)."""
+    d = os.path.join(EXAMPLES, "multiclass_classification")
+    model = str(tmp_path / "mc.txt")
+    app = Application([
+        f"config={d}/train.conf", f"data={d}/multiclass.train",
+        f"valid_data={d}/multiclass.test", "num_trees=6",
+        f"output_model={model}", "verbose=-1", "metric_freq=0",
+        "early_stopping=0"])
+    app.run()
+    assert os.path.exists(model)
+    mlogloss = app.boosting.get_eval_at(1)[0]
+    assert np.isfinite(mlogloss) and mlogloss < 1.7  # log(5) ~ 1.61 at init

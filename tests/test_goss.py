@@ -117,3 +117,31 @@ def test_goss_fused_matches_sequential(partitioned):
         np.testing.assert_array_equal(ts.threshold_in_bin, tf.threshold_in_bin)
         np.testing.assert_allclose(ts.leaf_value, tf.leaf_value,
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_goss_blockwise_engine_matches_per_iteration():
+    """GOSS overrides the fused in-bag hook; the engine's blockwise
+    valid+early-stop replay must still produce identical models, stop
+    round, and eval history to the per-iteration loop."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(12)
+    x = rng.randn(3000, 8)
+    y = (x[:, 0] + 0.4 * rng.randn(3000) > 0).astype(float)
+    xv = rng.randn(800, 8)
+    yv = (xv[:, 0] + 0.4 * rng.randn(800) > 0).astype(float)
+    res = []
+    for force_periter in (True, False):
+        dtr = lgb.Dataset(x, y)
+        dva = lgb.Dataset(xv, yv, reference=dtr)
+        ev = {}
+        cbs = [lambda env: None] if force_periter else None
+        b = lgb.train({"objective": "binary", "boosting_type": "goss",
+                       "metric": "auc", "num_leaves": 15, "verbose": -1},
+                      dtr, 20, valid_sets=[dva], early_stopping_rounds=5,
+                      evals_result=ev, verbose_eval=False, callbacks=cbs)
+        res.append((b.gbdt.save_model_to_string(), b.best_iteration,
+                    tuple(ev["valid_0"]["auc"])))
+    (m1, b1, h1), (m2, b2, h2) = res
+    assert m1 == m2
+    assert b1 == b2
+    np.testing.assert_allclose(h1, h2, atol=1e-9)
