@@ -115,9 +115,12 @@ def _seg_hist_kernel(lohi_ref, words_ref, ghc_ref, out_ref, *, f, b_pad):
         out_ref[...] = jnp.zeros_like(out_ref)
 
     c = words_ref.shape[1]
-    pos = step * c + jax.lax.broadcasted_iota(jnp.int32, (c,), 0)
+    # 2-D iota, kept 2-D: a bare 1-D iota fails TPU pallas lowering
+    # (pallas_guide.md "TPU requires at least 2D iota"), and staying
+    # (C, 1) lets the mask broadcast into (C, 3) with no rank changes
+    pos = step * c + jax.lax.broadcasted_iota(jnp.int32, (c, 1), 0)
     mask = ((pos >= lohi_ref[0]) & (pos < lohi_ref[1])).astype(jnp.float32)
-    ghc_m = ghc_ref[...] * mask[:, None]                          # (C, 3)
+    ghc_m = ghc_ref[...] * mask                                   # (C, 3)
     b_iota = jax.lax.broadcasted_iota(jnp.int32, (b_pad, c), 0)
     for i in range(f):
         word = words_ref[i >> 2, :]
