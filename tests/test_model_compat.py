@@ -70,6 +70,12 @@ def _assert_preds_match(got, want, rtol=1e-5, atol=2e-6):
     elem_bad = np.abs(g - w) > (atol + rtol * np.abs(w))
     row_bad = elem_bad.any(axis=1)
     assert row_bad.mean() <= 0.005, f"{row_bad.sum()} rows diverge"
+    # a tie-flip reroutes a few trees, it does not corrupt the row:
+    # divergent rows still stay within 10% of the prediction range
+    if row_bad.any():
+        spread = max(float(w.max() - w.min()), 1e-12)
+        np.testing.assert_allclose(g[row_bad], w[row_bad],
+                                   rtol=0, atol=0.1 * spread)
     np.testing.assert_allclose(g[~row_bad], w[~row_bad],
                                rtol=rtol, atol=atol)
 
