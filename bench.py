@@ -422,25 +422,31 @@ def _format_result(res, reason):
         "path": res.get("path", "none"),
         "backend_note": reason,
     }
-    full = rows == N_ROWS and iters == NUM_ITERATIONS
-    if full:
-        # the measured reference AUC only describes the FULL workload
-        # (100 iterations at 1M rows) — a 10-iteration scaled run's AUC
-        # beside it would read as a quality regression
+    if (rows, iters) == (1_000_000, 100):
+        # the measured reference AUC only describes the canonical
+        # workload (100 iterations at 1M rows) — a 10-iteration scaled
+        # run's AUC beside it would read as a quality regression
         result["ref_auc"] = 0.9338
     if res.get("time_s"):
-        if full:
-            result["vs_baseline"] = round(REF_TRAIN_SECONDS / res["time_s"], 3)
+        # ONE reference-time rule for every workload, anchored to the
+        # canonical 1M x 100 measurement (REF_TRAIN_SECONDS, overridable
+        # via BENCH_REF_SECONDS — a re-anchor rescales everything):
+        # a workload measured with the rebuilt reference CLI on this
+        # container uses that number (x the re-anchor ratio); anything
+        # else scales the canonical time linearly in rows x iterations.
+        anchor = REF_TRAIN_SECONDS / 22.2  # 1.0 unless re-anchored
+        measured = {(1_000_000, 100): 22.2,
+                    (100_000, 10): 0.29}.get((rows, iters))
+        if measured is not None:
+            ref_t = measured * anchor
+            if (rows, iters) != (1_000_000, 100):
+                result["ref_measured_s"] = round(ref_t, 3)
         else:
-            # reduced rung: compare against the reference time scaled
-            # linearly in rows x iterations (marked as an estimate).
-            # REF_TRAIN_SECONDS is anchored to the FIXED 1M x 100
-            # reference workload, not the env-overridable target.
-            ref_scaled = (REF_TRAIN_SECONDS * rows / 1_000_000
-                          * iters / 100)
-            result["vs_baseline"] = round(ref_scaled / res["time_s"], 4)
+            ref_t = REF_TRAIN_SECONDS * rows / 1_000_000 * iters / 100
+            result["ref_scaled_estimate_s"] = round(ref_t, 3)
+        result["vs_baseline"] = round(ref_t / res["time_s"], 4)
+        if (rows, iters) != (N_ROWS, NUM_ITERATIONS):
             result["scaled_workload"] = True
-            result["ref_scaled_estimate_s"] = round(ref_scaled, 3)
             result["full_workload"] = f"{N_ROWS}x28x{NUM_ITERATIONS}iter"
     else:
         result["vs_baseline"] = 0.0
