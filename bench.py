@@ -413,11 +413,16 @@ def _ref_time(rows, iters):
     re-anchor ratio); anything else scales the canonical time linearly
     in rows x iterations. Returns (seconds, was_measured)."""
     anchor = REF_TRAIN_SECONDS / 22.2  # 1.0 unless re-anchored
-    measured = {(1_000_000, 100): 22.2,
-                (11_000_000, 100): 411.2,  # HIGGS scale (BASELINE.md)
-                (100_000, 10): 0.29}.get((rows, iters))
-    if measured is not None:
-        return measured * anchor, True
+    # per-row-count measurements (iters at which they were taken):
+    # row scaling is super-linear (cache effects, BASELINE.md), so a
+    # measured row anchor beats scaling rows from 1M; iterations DO
+    # scale linearly at fixed rows
+    row_anchor = {1_000_000: (100, 22.2),
+                  11_000_000: (100, 411.2),
+                  100_000: (10, 0.29)}.get(rows)
+    if row_anchor is not None:
+        m_iters, m_secs = row_anchor
+        return m_secs * anchor * iters / m_iters, iters == m_iters
     return REF_TRAIN_SECONDS * rows / 1_000_000 * iters / 100, False
 
 
