@@ -9,7 +9,6 @@ added.
 """
 
 import numpy as np
-import pytest
 from sklearn import datasets, model_selection
 
 import lightgbm_tpu as lgb
