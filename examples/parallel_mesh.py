@@ -1,7 +1,8 @@
 """The three distributed tree learners on a multi-device mesh.
 
-Run with a virtual CPU mesh:
-  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+Run with a virtual CPU mesh (from the repo root):
+  PYTHONPATH=. PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python examples/parallel_mesh.py
 
 On TPU hardware the same code spans the real chips; multi-host setups

@@ -11,6 +11,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the tests must NEVER touch the TPU tunnel: emptying POOL_IPS skips the
+# axon plugin registration entirely (JAX_PLATFORMS=cpu alone still
+# registers it, and a single-grant tunnel serializes every process that
+# does — a dead/wedged relay would hang the suite)
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 import jax  # noqa: E402
 

@@ -46,3 +46,22 @@ def test_segment_kernel_interpret_matches_xla():
         want = want_fn(jnp.int32(begin), jnp.int32(cnt))
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-4)
+
+
+def test_segment_kernel_interpret_bench_shape():
+    """The exact histogram geometry of the driver benchmark (28
+    features -> 7 packed words, max_bin 255 -> one padded 256-bin
+    tile): kernel-body semantics pinned in interpret mode before the
+    first real-TPU run ever happens."""
+    rng = np.random.RandomState(4)
+    f, n, b = 28, 2 * HIST_CHUNK, 255
+    bins = rng.randint(0, b, size=(f, n), dtype=np.uint8)
+    words = jnp.asarray(pack_feature_words(bins))
+    ghc_t = jnp.asarray(rng.rand(3, n).astype(np.float32))
+    begin, cnt = jnp.int32(HIST_CHUNK - 9), jnp.int32(HIST_CHUNK // 2)
+    got = segment_histograms(words, ghc_t, begin, cnt, b, f=f,
+                             interpret_backend="tpu", interpret=True)
+    want = segment_histograms(words, ghc_t, begin, cnt, b, f=f,
+                              interpret_backend="cpu")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-4)
