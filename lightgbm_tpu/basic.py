@@ -20,7 +20,7 @@ Mirrored semantics:
 import numpy as np
 
 from .config import Config, key_alias_transform
-from .io.dataset import CoreDataset, DatasetLoader
+from .io.dataset import DatasetLoader
 from .io.parser import parse_text_file
 from .metrics import create_metric
 from .models.gbdt import create_boosting

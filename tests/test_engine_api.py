@@ -6,7 +6,6 @@ load_diabetes with thresholds recalibrated to that dataset (label std
 """
 
 import math
-import os
 
 import numpy as np
 import pytest
