@@ -31,6 +31,8 @@ def main():
     }
     if os.environ.get("LIGHTGBM_TPU_TEST_TWO_ROUND"):
         params["use_two_round_loading"] = True
+    if os.environ.get("LIGHTGBM_TPU_TEST_PARTITIONED"):
+        params["partitioned_build"] = "true"
     cfg = Config.from_params(params)
     init_from_config(cfg)
 
@@ -49,6 +51,8 @@ def main():
     obj.init(ds.metadata, ds.num_data)
     b = GBDT()
     b.init(cfg, ds, obj, [])
+    if os.environ.get("LIGHTGBM_TPU_TEST_PARTITIONED"):
+        assert b.tree_learner._use_partitioned  # no silent masked fallback
     for _ in range(cfg.num_iterations):
         b.train_one_iter(is_eval=False)
     if rank == 0:

@@ -56,6 +56,25 @@ def _run_two_workers(tmp_path, extra_env=None):
     return out_model
 
 
+def _train_local(params, data_path=BINARY_TRAIN):
+    """Single-process reference run for comparisons with the 2-process
+    workers: same loader/objective/GBDT driver sequence."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    cfg = Config.from_params(params)
+    ds = DatasetLoader(cfg).load_from_file(data_path)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    b = GBDT()
+    b.init(cfg, ds, obj, [])
+    for _ in range(cfg.num_iterations):
+        b.train_one_iter(is_eval=False)
+    return b
+
+
 def test_two_process_data_parallel_matches_single(tmp_path):
     # GLOBAL_ROWS makes the worker assert global_num_data==7000 and that
     # each rank holds a strict subset (catches a silently-unset rank
@@ -64,23 +83,13 @@ def test_two_process_data_parallel_matches_single(tmp_path):
         tmp_path, extra_env={"LIGHTGBM_TPU_TEST_GLOBAL_ROWS": "7000"})
 
     # single-process reference run (2 local devices, full data)
-    from lightgbm_tpu.config import Config
-    from lightgbm_tpu.io.dataset import DatasetLoader
-    from lightgbm_tpu.models.gbdt import GBDT, create_boosting
-    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.models.gbdt import create_boosting
 
-    cfg = Config.from_params({
+    b = _train_local({
         "objective": "binary", "num_leaves": 15, "num_iterations": 5,
         "tree_learner": "data", "min_data_in_leaf": 20, "metric_freq": 0,
         "enable_load_from_binary_file": False,
     })
-    ds = DatasetLoader(cfg).load_from_file(BINARY_TRAIN)
-    obj = create_objective(cfg.objective, cfg)
-    obj.init(ds.metadata, ds.num_data)
-    b = GBDT()
-    b.init(cfg, ds, obj, [])
-    for _ in range(cfg.num_iterations):
-        b.train_one_iter(is_eval=False)
 
     dist = create_boosting("gbdt")
     dist.load_model_from_string(out_model.read_text())
@@ -141,3 +150,35 @@ def test_two_round_rank_filtered_streaming_matches_single(tmp_path):
                                    rtol=1e-12)
         np.testing.assert_allclose(t_dist.leaf_value, t_local.leaf_value,
                                    rtol=2e-4, atol=1e-7)
+
+
+def test_two_process_partitioned_data_parallel(tmp_path):
+    """Multi-host + the leaf-contiguous builder: two jax.distributed
+    processes train the row-sharded partitioned core (per-shard packed
+    words, one psum per segment histogram). The partitioned DP's plain
+    f32 psum guarantees cross-shard consistency, not last-ulp equality
+    with other device topologies (models/partitioned.py docstring), so
+    this pins execution + predictive equivalence rather than exact tree
+    equality: same tree count and raw scores within f32 psum wiggle of
+    the single-process serial partitioned model."""
+    out_model = _run_two_workers(
+        tmp_path, extra_env={"LIGHTGBM_TPU_TEST_PARTITIONED": "1",
+                             "LIGHTGBM_TPU_TEST_GLOBAL_ROWS": "7000"})
+
+    from lightgbm_tpu.io.parser import parse_text_file
+    from lightgbm_tpu.models.gbdt import create_boosting
+
+    b = _train_local({
+        "objective": "binary", "num_leaves": 15, "num_iterations": 5,
+        "tree_learner": "serial", "partitioned_build": "true",
+        "min_data_in_leaf": 20, "metric_freq": 0,
+        "enable_load_from_binary_file": False,
+    })
+    assert b.tree_learner._use_partitioned
+
+    dist = create_boosting("gbdt")
+    dist.load_model_from_string(out_model.read_text())
+    assert len(dist.models) == len(b.models) == 5
+    _, feats, _, _, _ = parse_text_file(BINARY_TRAIN)
+    np.testing.assert_allclose(dist.predict_raw(feats),
+                               b.predict_raw(feats), atol=5e-3)
