@@ -136,8 +136,28 @@ def kill_stray_tunnel_clients():
                 continue
             if pid not in me:
                 pids.add(pid)
+    # Only python processes can be tunnel (PJRT plugin) clients; an
+    # unrelated local service that happens to talk to these ports must
+    # not be collateral. Log each cmdline before signalling so a wrong
+    # kill is at least diagnosable.
+    spared = []
+    for pid in sorted(pids):
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace").strip()
+        except OSError:
+            cmd = ""
+        if "python" not in cmd:
+            spared.append(pid)
+            pids.discard(pid)
+            _mark(f"sparing non-python relay peer pid={pid} cmd={cmd!r}")
+        else:
+            _mark(f"will terminate stray tunnel client pid={pid} "
+                  f"cmd={cmd!r}")
     if not pids:
-        return "no stray tunnel clients"
+        return ("no stray tunnel clients" if not spared
+                else f"only non-python relay peers {spared}; spared")
     for pid in pids:
         try:
             os.kill(pid, signal.SIGTERM)

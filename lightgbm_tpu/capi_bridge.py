@@ -372,4 +372,8 @@ def booster_get_leaf_value(cb, tree_idx, leaf_idx, out_val_addr):
 
 
 def booster_set_leaf_value(cb, tree_idx, leaf_idx, val):
+    # In-place Tree mutation bypasses _VersionedList's mutation counter;
+    # bump it so the (n_used, len, version)-keyed prediction caches
+    # (_stack_cache / _dev_model_cache) can't serve the pre-edit model.
     cb.booster.gbdt.models[tree_idx].leaf_value[leaf_idx] = float(val)
+    cb.booster.gbdt.models._bump()

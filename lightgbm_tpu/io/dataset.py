@@ -460,7 +460,7 @@ class DatasetLoader:
         # path's (same sample rows, same greedy pass)
         from .bundling import plan_bundles
         plan = None
-        if cfg.is_enable_sparse and cfg.tree_learner != "feature":
+        if cfg.is_enable_sparse:
             sample_bins = np.stack(
                 [mappers[used_map[j]].value_to_bin(sample_feats[:, j])
                  for j in real_idx], axis=0)
@@ -686,7 +686,7 @@ class DatasetLoader:
         # (io/bundling.py; replaces the reference's sparse_bin storage)
         from .bundling import plan_bundles, build_stored_matrix
         plan = None
-        if cfg.is_enable_sparse and cfg.tree_learner != "feature":
+        if cfg.is_enable_sparse:
             sample_bins = np.stack(
                 [mappers[used_map[j]].value_to_bin(sample_col(j))
                  for j in real_idx], axis=0)

@@ -102,6 +102,34 @@ class _VersionedList(list):
         self._bump()
         super().__setitem__(key, value)
 
+    def insert(self, index, item):
+        self._bump()
+        super().insert(index, item)
+
+    def pop(self, index=-1):
+        self._bump()
+        return super().pop(index)
+
+    def remove(self, item):
+        self._bump()
+        super().remove(item)
+
+    def clear(self):
+        self._bump()
+        super().clear()
+
+    def __iadd__(self, items):
+        self._bump()
+        return super().__iadd__(items)
+
+    def sort(self, **kwargs):
+        self._bump()
+        super().sort(**kwargs)
+
+    def reverse(self):
+        self._bump()
+        super().reverse()
+
 
 class _BlockSnapshots:
     """Per-iteration score snapshots over one fused training block.
@@ -885,7 +913,21 @@ class GBDT:
             return cached[1]
         sf, thr, dt, lc, rc, lv, has_split, depth = \
             self._stacked_model_arrays(n_used)
-        dev = (jnp.asarray(sf), jnp.asarray(thr, jnp.float32),
+        # Numeric thresholds are f64 on the host path; round the f32 cast
+        # toward -inf so `x <= thr32` equals the f64 `x <= thr` for every
+        # f32-representable x (round-to-nearest could lift thr32 ABOVE
+        # thr and flip rows landing in between). Categorical thresholds
+        # are exact category ids: f32 holds ints < 2^24 exactly, and the
+        # id-vs-id equality below is unaffected by the adjustment only
+        # applied to numeric nodes.
+        thr32 = thr.astype(np.float32)
+        numeric = dt != Tree.CATEGORICAL
+        lifted = numeric & (thr32.astype(np.float64) > thr)
+        thr32 = np.where(lifted,
+                         np.nextafter(thr32, np.float32(-np.inf),
+                                      dtype=np.float32),
+                         thr32)
+        dev = (jnp.asarray(sf), jnp.asarray(thr32, jnp.float32),
                jnp.asarray(dt == Tree.CATEGORICAL),
                jnp.asarray(lc), jnp.asarray(rc),
                jnp.asarray(lv, jnp.float32),

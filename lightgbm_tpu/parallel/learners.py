@@ -115,12 +115,13 @@ class _MeshedTreeLearner(SerialTreeLearner):
     partitioned_capable = False
 
     def _partitioned_enabled(self, cfg):
-        # EXPLICIT opt-in only for meshed learners ("auto" keeps the
-        # masked builder: the data-parallel default must preserve the
-        # reference's exact serial == parallel tree guarantee)
-        from ..models.tree_learner import _partitioned_mode
-        if _partitioned_mode(cfg) != "true":
-            return False
+        # Row-sharded learners follow the serial "auto" rule (TPU ->
+        # leaf-contiguous builder): the north-star data-parallel config
+        # must hit the fast core with no flag. The reference's EXACT
+        # serial == parallel tree guarantee remains available under
+        # partitioned_build=false (masked + Kahan pair-allreduce); the
+        # partitioned parity serial==parallel is pinned to f32
+        # summation-order ulps by test_parallel.py.
         return super()._partitioned_enabled(cfg)
 
     def init(self, train_set):
@@ -244,14 +245,15 @@ class _MeshedTreeLearner(SerialTreeLearner):
 class DataParallelTreeLearner(_MeshedTreeLearner):
     """Row-sharded learner (data_parallel_tree_learner.cpp).
 
-    Two cores: the masked builder with deterministic Kahan
-    pair-allreduce (default — including partitioned_build=auto — grows
-    trees IDENTICAL to the serial masked learner, the reference's
-    structural guarantee), and the partitioned builder (EXPLICIT
-    partitioned_build=true only) where each shard keeps its own
-    leaf-contiguous layout and every segment histogram is one f32 psum
-    — the fast path whose trees match the serial partitioned learner up
-    to f32 summation-order ulps."""
+    Two cores, selected like the serial learner's: the partitioned
+    (leaf-contiguous) builder — the default on TPU under
+    partitioned_build=auto — where each shard keeps its own layout and
+    every segment histogram is one f32 psum, matching the serial
+    partitioned learner up to f32 summation-order ulps; and the masked
+    builder (partitioned_build=false, and the non-TPU auto default)
+    whose deterministic Kahan pair-allreduce grows trees IDENTICAL to
+    the serial masked learner — the reference's structural
+    guarantee."""
     name = "data"
     shard_rows = True
     partitioned_capable = True
@@ -302,31 +304,77 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
     shard_rows = False
     shard_features = True
 
-    def init(self, train_set):
-        if train_set.bundle_plan is not None:
-            Log.fatal("feature-parallel does not support bundled datasets; "
-                      "set is_enable_sparse=false")
-        super().init(train_set)
-
     # replicate the split-column bin copy only below this size; larger
     # datasets keep the owner-broadcast psum (memory >> one allreduce
     # of (N,) int32 per split)
     REPLICATED_BINS_MAX_BYTES = 1 << 30
 
-    def _place_bins(self, bins):
+    def _setup_bundle_shards(self, stored):
+        """Bundled (EFB) datasets under feature sharding: virtual
+        features stay block-sharded in natural order (shard t owns
+        [t*f_loc, (t+1)*f_loc)), and each shard is handed exactly the
+        slot rows its features live in — at most f_loc distinct slots,
+        so per-shard storage never exceeds the unbundled layout. Slot
+        histograms expand to virtual features with per-shard LOCAL
+        gather maps (the feature-sharded analog of io/bundling.py's
+        expansion_maps; the reference's FP learner needs none of this
+        because every machine stores all features,
+        feature_parallel_tree_learner.cpp:28-43)."""
+        plan = self._bundle
+        k = self.n_shards
+        f_loc = self.f_pad // k
+        f_real = self.num_features
+        mappers = self.train_set.bin_mappers
+        b_stored = int(self.max_bin)
+        b_virtual = int(self.train_set.max_num_bin)
+        shard_slots = []
+        for t in range(k):
+            feats = np.arange(t * f_loc, min((t + 1) * f_loc, f_real))
+            shard_slots.append(np.unique(plan.feat_slot[feats])
+                               if len(feats) else np.zeros(0, np.int64))
+        s_loc = max(1, max(len(s) for s in shard_slots))
+        sel = np.zeros(k * s_loc, np.int64)
+        pad_cell = s_loc * b_stored        # flattened index of a zero row
+        src = np.full((self.f_pad, b_virtual), pad_cell, np.int32)
+        slot_of = np.full(self.f_pad, s_loc, np.int32)  # pad -> zero total
+        for t, slots in enumerate(shard_slots):
+            sel[t * s_loc:t * s_loc + len(slots)] = slots
+            local = {int(s): i for i, s in enumerate(slots)}
+            for j in range(t * f_loc, min((t + 1) * f_loc, f_real)):
+                li = local[int(plan.feat_slot[j])]
+                slot_of[j] = li
+                off = int(plan.feat_offset[j])
+                nb = int(mappers[j].num_bin)
+                src[j, 1:nb] = li * b_stored + off + np.arange(1, nb)
+        self._fp_s_loc = s_loc
+        self._fp_src = self._place_rep(src)
+        self._fp_slot_of = self._place_rep(slot_of)
+        return stored[sel]                 # (k * s_loc, N) stacked
+
+    def _keep_replicated_copy(self, bins):
         # the reference stores ALL data on every machine in feature-
         # parallel mode (feature_parallel_tree_learner.cpp); when that
         # fits, keep a replicated copy for split-column reads so applying
         # a split needs no collective
         if bins.nbytes > self.REPLICATED_BINS_MAX_BYTES:
             self._bins_replicated = None
-            return super()._place_bins(bins)
+            return
         rep = NamedSharding(self.mesh, P())
         if self.n_proc > 1:
             from .distributed import place_replicated
             self._bins_replicated = place_replicated(rep, bins)
         else:
             self._bins_replicated = jax.device_put(bins, rep)
+
+    def _place_bins(self, bins):
+        if getattr(self, "_bundle", None) is not None:
+            # strip the generic virtual-feature zero-pad rows appended
+            # past the stored slot matrix, then stack per-shard slots
+            stored = np.ascontiguousarray(bins[:self._bundle.num_slots])
+            self._keep_replicated_copy(stored)
+            stacked = self._setup_bundle_shards(stored)
+            return super()._place_bins(stacked)
+        self._keep_replicated_copy(bins)
         return super()._place_bins(bins)
 
     def _make_build_core(self, cfg, chunk):
@@ -337,9 +385,19 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
         f_loc = self.f_pad // self.n_shards
 
         replicated = self._bins_replicated is not None
+        bundled = getattr(self, "_bundle", None) is not None
+        s_loc = self._fp_s_loc if bundled else f_loc
+
+        # replicated bundle tables are closed over (same pattern as the
+        # row-sharded learners' _bundle_kwargs); only the genuinely
+        # PER-SHARD maps (src_loc, slot_of_loc) travel as operands
+        if bundled:
+            fslot_full = self._bundle_feat_slot
+            nbv_full = self._num_bin_pf          # global virtual (f_pad,)
+            bundle_window = self._bundle_window
 
         def fp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
-                  is_cat_full, bins_full):
+                  is_cat_full, bins_full, src_loc, slot_of_loc):
             shard = jax.lax.axis_index(AXIS)
 
             def sum_bcast(s):
@@ -362,18 +420,42 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
                 widx = jnp.argmax(gathered.gain)
                 return jax.tree_util.tree_map(lambda x: x[widx], gathered)
 
+            def expand(h):
+                # local slot histogram -> this shard's virtual features
+                # (per-shard maps from _setup_bundle_shards); the
+                # appended zero rows serve both the unused-bin pad cell
+                # and pad features' slot totals
+                kk = h.shape[-1]
+                flat = jnp.concatenate(
+                    [h.reshape(-1, kk), jnp.zeros((1, kk), h.dtype)], axis=0)
+                hv = jnp.take(flat, src_loc, axis=0)       # (f_loc, B_v, 3)
+                slot_tot = jnp.concatenate(
+                    [jnp.sum(h, axis=1), jnp.zeros((1, kk), h.dtype)], axis=0)
+                hv0 = (jnp.take(slot_tot, slot_of_loc, axis=0)
+                       - jnp.sum(hv[:, 1:, :], axis=1))
+                return hv.at[:, 0, :].set(hv0)
+
             def split_col(feat):
                 # the reference stores ALL data per machine in feature-
                 # parallel mode; when the replicated copy fits (see
                 # _place_bins), the split column is a direct read and
                 # applying a split needs no collective. Otherwise fall
                 # back to broadcasting the owner shard's column.
-                if replicated:
+                if replicated and not bundled:
                     return jnp.take(bins_full, feat, axis=0).astype(jnp.int32)
+                if replicated:
+                    sc = jnp.take(bins_full, fslot_full[feat],
+                                  axis=0).astype(jnp.int32)
+                    return bundle_window(sc, feat, nbv_full)
                 lo = shard * f_loc
                 owned = (feat >= lo) & (feat < lo + f_loc)
                 local_feat = jnp.clip(feat - lo, 0, f_loc - 1)
-                col = jnp.take(bins, local_feat, axis=0).astype(jnp.int32)
+                if bundled:
+                    lsl = jnp.clip(slot_of_loc[local_feat], 0, s_loc - 1)
+                    sc = jnp.take(bins, lsl, axis=0).astype(jnp.int32)
+                    col = bundle_window(sc, feat, nbv_full)
+                else:
+                    col = jnp.take(bins, local_feat, axis=0).astype(jnp.int32)
                 return jax.lax.psum(jnp.where(owned, col, 0), AXIS)
 
             return build_tree_device(
@@ -381,20 +463,27 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
                 sum_psum_fn=sum_bcast,
-                evaluate_fn=evaluate, split_col_fn=split_col)
+                evaluate_fn=evaluate, split_col_fn=split_col,
+                expand_fn=expand if bundled else (lambda h: h))
 
         def wrapped7(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
             inner = jax.shard_map(
                 fp_fn, mesh=self.mesh,
                 in_specs=(P(AXIS, None), P(None), P(None), P(None),
-                          P(AXIS), P(AXIS), P(AXIS), P(None), P(None)),
+                          P(AXIS), P(AXIS), P(AXIS), P(None), P(None),
+                          P(AXIS, None), P(AXIS)),
                 out_specs=self._out_specs(), check_vma=False)
-            # dummy stand-in when the replicated copy was too large: the
-            # traced split_col never reads it
+            # dummy stand-ins for paths the traced fn never reads
             bins_full = (self._bins_replicated if replicated
                          else jnp.zeros((1, 1), bins.dtype))
+            if bundled:
+                src_loc, slot_of_loc = self._fp_src, self._fp_slot_of
+            else:
+                k = self.n_shards
+                src_loc = jnp.zeros((k, 1), jnp.int32)
+                slot_of_loc = jnp.zeros(k, jnp.int32)
             return inner(bins, grad, hess, inbag, fmask, num_bin_pf,
-                         is_cat, is_cat, bins_full)
+                         is_cat, is_cat, bins_full, src_loc, slot_of_loc)
 
         return wrapped7
 

@@ -37,12 +37,18 @@ def sparse_data():
 
 def _train(x, y, enable_sparse, learner="serial", rounds=6,
            partitioned="false"):
+    # num_machines > 1 is required for a parallel learner to survive
+    # check_param_conflict (config.cpp:139-147 parity: one machine
+    # means serial); 4 maps to 4 of the virtual CPU mesh devices
     cfg = Config.from_params({
         "objective": "binary", "num_leaves": 15, "min_data_in_leaf": 10,
         "num_iterations": rounds, "metric_freq": 0,
         "is_enable_sparse": enable_sparse, "tree_learner": learner,
         "device_row_chunk": 512, "partitioned_build": partitioned,
+        "num_machines": 1 if learner == "serial" else 4,
     })
+    if learner != "serial":
+        assert cfg.tree_learner == learner
     ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
     obj = create_objective(cfg.objective, cfg)
     obj.init(ds.metadata, ds.num_data)
@@ -102,6 +108,48 @@ def test_bundled_data_parallel_partitioned(sparse_data):
     b2, _ = _train(x, y, enable_sparse=True, learner="data",
                    partitioned="true")
     assert b2.tree_learner._use_partitioned
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature_real,
+                                      t2.split_feature_real)
+        np.testing.assert_array_equal(t1.threshold_in_bin,
+                                      t2.threshold_in_bin)
+
+
+def test_bundled_feature_parallel(sparse_data):
+    """Feature-parallel on a BUNDLED dataset: each shard holds exactly
+    the slot rows its virtual feature block lives in, expands slot
+    histograms through per-shard local maps, and decodes split columns
+    through the shared bundle window rule — trees must match the serial
+    bundled learner (feature_parallel_tree_learner.cpp:28-43 handles
+    any dataset; parity hole closed)."""
+    x, y = sparse_data
+    b1, _ = _train(x, y, enable_sparse=True, learner="serial")
+    assert b1.tree_learner._bundle is not None
+    b2, _ = _train(x, y, enable_sparse=True, learner="feature")
+    assert b2.tree_learner._bundle is not None
+    for t1, t2 in zip(b1.models, b2.models):
+        np.testing.assert_array_equal(t1.split_feature_real,
+                                      t2.split_feature_real)
+        np.testing.assert_array_equal(t1.threshold_in_bin,
+                                      t2.threshold_in_bin)
+        np.testing.assert_allclose(t1.leaf_value, t2.leaf_value,
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_bundled_feature_parallel_psum_fallback(sparse_data):
+    """Same parity with the replicated stored copy disabled (the >1GB
+    owner-broadcast psum path, threshold forced to 0)."""
+    import lightgbm_tpu.parallel.learners as L
+    x, y = sparse_data
+    b1, _ = _train(x, y, enable_sparse=True, learner="serial", rounds=3)
+    old = L.FeatureParallelTreeLearner.REPLICATED_BINS_MAX_BYTES
+    L.FeatureParallelTreeLearner.REPLICATED_BINS_MAX_BYTES = 0
+    try:
+        b2, _ = _train(x, y, enable_sparse=True, learner="feature",
+                       rounds=3)
+    finally:
+        L.FeatureParallelTreeLearner.REPLICATED_BINS_MAX_BYTES = old
+    assert b2.tree_learner._bins_replicated is None
     for t1, t2 in zip(b1.models, b2.models):
         np.testing.assert_array_equal(t1.split_feature_real,
                                       t2.split_feature_real)

@@ -274,3 +274,34 @@ def test_csr_binning_matches_dense():
     assert len(ds_dense.bin_mappers) == len(ds_sparse.bin_mappers)
     for ma, mb in zip(ds_dense.bin_mappers, ds_sparse.bin_mappers):
         np.testing.assert_array_equal(ma.bin_upper_bound, mb.bin_upper_bound)
+
+
+def test_set_leaf_value_invalidates_predict_cache():
+    """LGBM_BoosterSetLeafValue mutates a Tree in place, bypassing the
+    model list's mutation counter; the bridge must bump it so the
+    (n_used, len, version)-keyed stacked/device prediction caches do not
+    serve the pre-edit model (e.g. a refit flow)."""
+    import types
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import capi_bridge
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(1200, 5)
+    y = (x[:, 0] + 0.3 * rng.randn(1200) > 0).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(x, y), num_boost_round=3)
+    gb = b.gbdt
+    xq = rng.randn(150, 5)
+    p_before = gb.predict_raw(xq)          # populates the stack cache
+    cb = types.SimpleNamespace(booster=b)
+    tree = gb.models[0].materialize() if hasattr(gb.models[0], "materialize") \
+        else gb.models[0]
+    old = float(tree.leaf_value[0])
+    capi_bridge.booster_set_leaf_value(cb, 0, 0, old + 5.0)
+    p_after = gb.predict_raw(xq)
+    assert not np.allclose(p_before, p_after)
+    # and the fresh prediction matches a cache-free recomputation
+    gb._stack_cache = None
+    gb._dev_model_cache = None
+    np.testing.assert_allclose(gb.predict_raw(xq), p_after, atol=1e-12)
