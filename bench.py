@@ -216,6 +216,16 @@ def make_data(n, f=N_FEATURES, seed=42):
     w = rng.randn(f).astype(np.float32) / np.sqrt(f)
     logit = x @ w + 0.5 * rng.randn(n).astype(np.float32)
     y = (logit > 0).astype(np.float32)
+    # memo-buster: the tunnel caches whole dispatches keyed on (program,
+    # inputs) ACROSS sessions, so a re-run of the exact seed-42 train
+    # would report a cache hit as a train time. Flipping a handful of
+    # labels per process makes the device inputs unique (AUC moves by
+    # ~1e-5 at bench scale); BENCH_NO_MEMO_BUST pins the exact data.
+    if not os.environ.get("BENCH_NO_MEMO_BUST"):
+        bust = int.from_bytes(os.urandom(4), "big")
+        idx = np.random.RandomState(bust).choice(n, size=min(8, n),
+                                                 replace=False)
+        y[idx] = 1.0 - y[idx]
     return x, y
 
 
@@ -277,9 +287,13 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     # builder with one training round and roll it back so the timed model
     # has exactly n_iters trees (AUC comparable to the baseline)
     _mark(f"compiling fused {block}-iteration program")
+    from lightgbm_tpu.utils.timers import TIMERS
+    TIMERS.reset()
+    t0 = time.time()
     if not booster.warm_up_fused(block):
         booster.train_one_iter(is_eval=False)
         booster.rollback_one_iter()
+    TIMERS.add("compile", time.time() - t0)
     _mark("compile done, starting timed loop")
 
     t0 = time.time()
@@ -295,7 +309,7 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     auc_metric = create_metric("auc", cfg)
     auc_metric.init(ds.metadata, ds.num_data)
     auc = float(auc_metric.eval(booster.get_training_score())[0])
-    return train_s, auc, booster, load_s
+    return train_s, auc, booster, load_s, TIMERS.snapshot()
 
 
 def run_child():
@@ -319,13 +333,18 @@ def run_child():
         jax.config.update("jax_platforms", "cpu")
     n_rows = int(os.environ["BENCH_CHILD_ROWS"])
     n_iters = int(os.environ.get("BENCH_CHILD_ITERS", NUM_ITERATIONS))
-    train_s, auc, booster, load_s = train_once(n_rows, n_iters)
+    train_s, auc, booster, load_s, phases = train_once(n_rows, n_iters)
     # the TRAIN result prints FIRST: the optional predict timing below
     # must not be able to cost us the primary measurement (watchdog)
-    print("CHILD_RESULT " + json.dumps(
-        {"time_s": round(train_s, 3), "auc": round(auc, 5),
-         "n_rows": n_rows, "n_iters": n_iters, "load_s": round(load_s, 3),
-         "platform": jax.devices()[0].platform}), flush=True)
+    res = {"time_s": round(train_s, 3), "auc": round(auc, 5),
+           "n_rows": n_rows, "n_iters": n_iters, "load_s": round(load_s, 3),
+           "platform": jax.devices()[0].platform,
+           "phases": phases}
+    # a full boosting iteration at >=100k rows cannot run in <1 ms; a
+    # smaller number means the tunnel served a memoized dispatch
+    if n_rows >= 100_000 and train_s / max(n_iters, 1) < 1e-3:
+        res["memo_suspect"] = True
+    print("CHILD_RESULT " + json.dumps(res), flush=True)
     if not os.environ.get("BENCH_SKIP_PREDICT"):
         # batch prediction over the full matrix (device traversal above
         # GBDT.DEVICE_PREDICT_CELLS; reference predictor.hpp:82-130)
@@ -489,6 +508,10 @@ def _format_result(res, reason):
         result["error"] = res["error"]
     if "fallback_from" in res:
         result["fallback_note"] = res["fallback_from"]
+    if res.get("phases"):
+        result["phases"] = res["phases"]
+    if res.get("memo_suspect"):
+        result["memo_suspect"] = True
     return result
 
 

@@ -60,12 +60,18 @@ def plan_bundles(mappers, sample_bins, enable=True):
 
     Args:
       mappers: per (used) feature BinMapper.
-      sample_bins: (F, S_rows) int bins of the sample rows.
+      sample_bins: (F, S_rows) int bins of the sample rows, OR a
+        callable j -> (S_rows,) bins so a wide-sparse dataset plans in
+        O(one column + bundles x S_rows) memory instead of the dense
+        (F, S_rows) stack (the planning analog of the reference never
+        densifying sparse features, src/io/sparse_bin.hpp:17-331).
       enable: config is_enable_sparse.
 
     Returns a BundlePlan (identity when nothing bundles).
     """
     f = len(mappers)
+    col_bins = sample_bins if callable(sample_bins) \
+        else (lambda j: sample_bins[j])
     identity = BundlePlan(np.arange(f), np.zeros(f, np.int32),
                           [m.num_bin for m in mappers], f)
     if not enable or f == 0:
@@ -80,28 +86,50 @@ def plan_bundles(mappers, sample_bins, enable=True):
     if len(candidates) < 2:
         return identity
 
-    nnz = {j: np.count_nonzero(sample_bins[j]) for j in candidates}
+    nnz = {j: np.count_nonzero(col_bins(j)) for j in candidates}
     order = sorted(candidates, key=lambda j: -nnz[j])
-    bundles = []   # list of (member list, occupied bool rows, used bins)
+    # First-fit greedy with a vectorized signature prefilter. Occupancy
+    # is bit-packed (cnt/8 bytes per bundle) and the first SIG bytes
+    # double as a per-bundle signature: a signature hit IS a real
+    # conflict on those rows (never a false positive), so one (B, SIG)
+    # AND prunes almost every conflicting bundle and the exact packed
+    # check runs only on survivors — same packing as the naive
+    # O(F x B x cnt) loop, at wide-sparse (news20-like) planning cost
+    # O(F x B x SIG).
+    cnt = len(col_bins(order[0]))
+    SIG = min(64, (cnt + 7) // 8)
+    cap = MAX_SLOT_BINS - 1
+    max_b = len(order)
+    sig_mat = np.zeros((max_b, SIG), np.uint8)
+    used_arr = np.zeros(max_b, np.int64)
+    occ = []         # per-bundle packed occupancy, (cnt/8,) uint8
+    members_l = []   # per-bundle member lists
     for j in order:
-        col_nz = sample_bins[j] > 0
+        col_nz = col_bins(j) > 0
+        cp = np.packbits(col_nz)
+        csig = cp[:SIG]
         nb = mappers[j].num_bin
-        placed = False
-        for b in bundles:
-            members, occupied, used = b
-            if used + (nb - 1) > MAX_SLOT_BINS - 1:
-                continue
-            if np.any(occupied & col_nz):
-                continue
-            members.append(j)
-            b[1] = occupied | col_nz
-            b[2] = used + (nb - 1)
-            placed = True
-            break
-        if not placed:
-            bundles.append([[j], col_nz.copy(), nb - 1])
+        b = len(occ)
+        placed = -1
+        if b:
+            viable = ~((sig_mat[:b] & csig).any(axis=1)) \
+                & (used_arr[:b] + (nb - 1) <= cap)
+            for idx in np.flatnonzero(viable):
+                if not (occ[idx] & cp).any():
+                    placed = int(idx)
+                    break
+        if placed >= 0:
+            members_l[placed].append(j)
+            occ[placed] |= cp
+            sig_mat[placed] |= csig
+            used_arr[placed] += nb - 1
+        else:
+            members_l.append([j])
+            occ.append(cp)
+            sig_mat[b] = csig
+            used_arr[b] = nb - 1
 
-    bundles = [b for b in bundles if len(b[0]) >= 2]
+    bundles = [(m,) for m in members_l if len(m) >= 2]
     if not bundles:
         return identity
 
@@ -110,7 +138,7 @@ def plan_bundles(mappers, sample_bins, enable=True):
     feat_offset = np.zeros(f, np.int32)
     slot_bins = []
     slot_id = 0
-    for members, _, _ in bundles:
+    for (members,) in bundles:
         off = 0
         for j in members:
             bundled.add(j)

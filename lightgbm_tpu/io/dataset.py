@@ -4,14 +4,20 @@ Reference: include/LightGBM/dataset.h:278-421, src/io/dataset.cpp,
 include/LightGBM/dataset_loader.h, src/io/dataset_loader.cpp:162-941.
 
 TPU-first design: the training data is stored as ONE dense features-major
-integer matrix `bins` of shape (num_used_features, num_data) — uint8 when
-every feature has <= 256 bins, else uint16 — pushed to device once and
+integer matrix `bins` of shape (num_stored_rows, num_data) — uint8 when
+every stored row has <= 256 bins, else uint16 — pushed to device once and
 read by every histogram kernel. The reference's per-feature Bin objects
 (dense/sparse/ordered variants, src/io/dense_bin.hpp / sparse_bin.hpp /
 ordered_sparse_bin.hpp) are CPU-cache layouts; on TPU one dense matrix
-feeds the MXU directly, and sparse features simply bin mostly to 0
-(`is_enable_sparse` is accepted and recorded per feature via sparse_rate,
-but storage is always dense in this build).
+feeds the MXU directly. Sparse data is handled by CAPACITY, not layout:
+exclusive feature bundling (io/bundling.py) packs mutually-exclusive
+sparse features into shared slots so stored rows ~ slots << features,
+and every ingestion path stays O(nnz) on the way there — CSC/CSR column
+sources bin one column at a time, LibSVM files stream as triplet blocks
+(_stream_sparse_libsvm), and EFB planning reads one sample column at a
+time. A wide sparse load that would still materialize a dense F x N
+matrix (nothing bundles) hits a loud budget guard (check_bins_budget)
+instead of silently OOMing.
 
 The binary dataset cache (reference dataset.cpp:133-212 with a magic
 token) is an .npz with the same role: skip text parsing + binning on
@@ -60,6 +66,25 @@ class _VirtualBinsView:
         off = self._plan.feat_offset[feat]
         nb = self._nb[feat]
         return np.where((sc > off) & (sc <= off + nb - 1), sc - off, 0)
+
+
+def check_bins_budget(rows, cols, itemsize, what):
+    """Loud guard before allocating a stored bin matrix: a wide sparse
+    dataset that failed to bundle would silently materialize the dense
+    F x N block the reference's SparseBin exists to avoid
+    (src/io/sparse_bin.hpp:17-331). Budget in GB via
+    LIGHTGBM_TPU_MAX_BINS_GB (default 16; <= 0 disables)."""
+    budget_gb = float(os.environ.get("LIGHTGBM_TPU_MAX_BINS_GB", "16"))
+    if budget_gb <= 0:
+        return
+    need = rows * cols * itemsize / (1 << 30)
+    if need > budget_gb:
+        Log.fatal(
+            "%s needs a %d x %d bin matrix (%.1f GB > budget %.0f GB). "
+            "For wide sparse data enable bundling (is_enable_sparse=true"
+            ") so exclusive features share slots; raise/disable the "
+            "budget with LIGHTGBM_TPU_MAX_BINS_GB if the dense matrix "
+            "is intended.", what, rows, cols, need, budget_gb)
 
 
 def _bin_columns_threaded(col_fn, count):
@@ -386,6 +411,15 @@ class DatasetLoader:
     def load_from_file_align_with_other_dataset(self, filename, train_ds) -> CoreDataset:
         """Valid-set path: bin with the TRAIN mappers (dataset_loader.cpp:222-266)."""
         cfg = self.config
+        from .parser import detect_format
+        if (detect_format(filename) == "libsvm"
+                and self.predict_fun is None
+                and cfg.weight_column == "" and cfg.group_column == ""):
+            # O(nnz) aligned route: stream triplets with the TRAIN
+            # mappers + bundle plan, never a dense (N, F) parse (a wide
+            # sparse valid file would OOM there). predict_fun needs raw
+            # values -> dense fallback.
+            return self._load_sparse_aligned(filename, train_ds)
         label, feats, names, fmt, _ = parse_text_file(
             filename, has_header=cfg.has_header, label_column=cfg.label_column)
         meta = Metadata(len(label))
@@ -445,26 +479,51 @@ class DatasetLoader:
         if group_idx >= 0:
             ignore.add(group_idx)
 
+        # O(nnz) route for LibSVM: triplet blocks + CSC sample, never a
+        # dense (rows, num_cols) float block — the streaming analog of
+        # the reference's SparseBin push path (src/io/sparse_bin.hpp:
+        # 17-331, auto-selected at sparse_rate >= 0.8, bin.cpp:291-302).
+        # Weight/group column configs fall back to the dense route
+        # (LibSVM files carry those via side files, not columns).
+        sparse_route = (fmt == "libsvm" and weight_idx < 0
+                        and group_idx < 0)
+
         # round one: sample rows, find mappers (identical draws and
         # therefore identical mappers to the in-memory path)
         cnt = min(cfg.bin_construct_sample_cnt, n)
         sample_idx = (np.arange(n, dtype=np.int64) if cnt == n
                       else Random(cfg.data_random_seed).sample(n, cnt).astype(np.int64))
-        sample_all = collect_sample_rows(filename, fmt, cfg.has_header,
-                                         num_cols, sample_idx)
-        sample_feats = sample_all[:, feat_cols]
+        if sparse_route:
+            from .streaming import collect_sample_csc
+            _, s_colptr, s_rows, s_vals = collect_sample_csc(
+                filename, cfg.has_header, num_feats, sample_idx)
+
+            def sample_feat_col(j):
+                out = np.zeros(cnt, dtype=np.float64)
+                sl = slice(s_colptr[j], s_colptr[j + 1])
+                out[s_rows[sl]] = s_vals[sl]
+                return out
+        else:
+            sample_all = collect_sample_rows(filename, fmt, cfg.has_header,
+                                             num_cols, sample_idx)
+            sample_feats = sample_all[:, feat_cols]
+
+            def sample_feat_col(j):
+                return sample_feats[:, j]
         mappers, used_map, real_idx = self._make_mappers(
-            lambda j: sample_feats[:, j], num_feats, ignore, categorical)
+            sample_feat_col, num_feats, ignore, categorical)
 
         # bundling plan from the sample — identical to the in-memory
-        # path's (same sample rows, same greedy pass)
+        # path's (same sample rows, same greedy pass); per-column
+        # callable so planning never builds the (F, sample) bins stack
         from .bundling import plan_bundles
         plan = None
         if cfg.is_enable_sparse:
-            sample_bins = np.stack(
-                [mappers[used_map[j]].value_to_bin(sample_feats[:, j])
-                 for j in real_idx], axis=0)
-            plan = plan_bundles(mappers, sample_bins, enable=True)
+            plan = plan_bundles(
+                mappers,
+                lambda u: mappers[u].value_to_bin(
+                    sample_feat_col(real_idx[u])),
+                enable=True)
             if plan.is_identity:
                 plan = None
 
@@ -490,49 +549,63 @@ class DatasetLoader:
             n_local = n
 
         # round two: stream blocks, pushing binned values + metadata columns
-        if plan is None:
+        if sparse_route:
+            bins, label = self._stream_sparse_libsvm(
+                filename, mappers, used_map, plan, n_local, lo, hi)
+            weights = qid = None
+            bundle_conflicts = 0
+        elif plan is None:
             dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
                      else np.uint16)
+            check_bins_budget(len(mappers), n_local,
+                              np.dtype(dtype).itemsize,
+                              "Dense (unbundled) streaming load")
             bins = np.empty((len(mappers), n_local), dtype=dtype)
         else:
             dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
                      else np.uint16)
+            check_bins_budget(plan.num_slots, n_local,
+                              np.dtype(dtype).itemsize,
+                              "Bundled streaming load")
             bins = np.zeros((plan.num_slots, n_local), dtype=dtype)
-        label = np.empty(n_local, dtype=np.float32)
-        weights = np.empty(n_local, dtype=np.float32) if weight_idx >= 0 else None
-        qid = np.empty(n_local, dtype=np.float64) if group_idx >= 0 else None
-        bundle_conflicts = 0
-        # double-buffered: the prefetch thread parses block k+1 while
-        # this loop bins block k (pipeline_reader.h:18-70)
-        from .streaming import prefetch_blocks
-        for start, block in prefetch_blocks(
-                iter_blocks(filename, fmt, cfg.has_header, num_cols)):
-            end = start + len(block)
-            if start >= hi:
-                break  # past this rank's range: skip the rest of the file
-            s0, e0 = max(start, lo), min(end, hi)
-            if e0 <= s0:
-                continue  # block before this rank's range
-            block = block[s0 - start:e0 - start]
-            ls, le = s0 - lo, e0 - lo   # local write positions
-            label[ls:le] = block[:, label_idx]
-            feats_block = block[:, feat_cols]
-            if weights is not None:
-                weights[ls:le] = feats_block[:, weight_idx]
-            if qid is not None:
-                qid[ls:le] = feats_block[:, group_idx]
-            for u, j in enumerate(real_idx):
-                col = mappers[u].value_to_bin(feats_block[:, j])
-                if plan is None:
-                    bins[u, ls:le] = col.astype(dtype)
-                else:
-                    s = plan.feat_slot[u]
-                    off = plan.feat_offset[u]
-                    seg = bins[s, ls:le]
-                    nz = col > 0
-                    bundle_conflicts += int((nz & (seg != 0)).sum())
-                    write = nz & (seg == 0)
-                    seg[write] = (col[write] + off).astype(dtype)
+        if not sparse_route:
+            label = np.empty(n_local, dtype=np.float32)
+            weights = (np.empty(n_local, dtype=np.float32)
+                       if weight_idx >= 0 else None)
+            qid = (np.empty(n_local, dtype=np.float64)
+                   if group_idx >= 0 else None)
+            bundle_conflicts = 0
+            # double-buffered: the prefetch thread parses block k+1 while
+            # this loop bins block k (pipeline_reader.h:18-70)
+            from .streaming import prefetch_blocks
+            for start, block in prefetch_blocks(
+                    iter_blocks(filename, fmt, cfg.has_header, num_cols)):
+                end = start + len(block)
+                if start >= hi:
+                    break  # past this rank's range: skip the rest
+                s0, e0 = max(start, lo), min(end, hi)
+                if e0 <= s0:
+                    continue  # block before this rank's range
+                block = block[s0 - start:e0 - start]
+                ls, le = s0 - lo, e0 - lo   # local write positions
+                label[ls:le] = block[:, label_idx]
+                feats_block = block[:, feat_cols]
+                if weights is not None:
+                    weights[ls:le] = feats_block[:, weight_idx]
+                if qid is not None:
+                    qid[ls:le] = feats_block[:, group_idx]
+                for u, j in enumerate(real_idx):
+                    col = mappers[u].value_to_bin(feats_block[:, j])
+                    if plan is None:
+                        bins[u, ls:le] = col.astype(dtype)
+                    else:
+                        s = plan.feat_slot[u]
+                        off = plan.feat_offset[u]
+                        seg = bins[s, ls:le]
+                        nz = col > 0
+                        bundle_conflicts += int((nz & (seg != 0)).sum())
+                        write = nz & (seg == 0)
+                        seg[write] = (col[write] + off).astype(dtype)
         if bundle_conflicts:
             Log.warning("Feature bundling: %d conflicting cells kept their "
                         "first member's bin", bundle_conflicts)
@@ -567,6 +640,128 @@ class DatasetLoader:
                      rank, num_machines, lo, hi, n)
         Log.info("Number of data: %d, number of features: %d (two-round)",
                  n_local, len(mappers))
+        return ds
+
+    def _stream_sparse_libsvm(self, filename, mappers, used_map, plan,
+                              n_local, lo, hi):
+        """Round two over LibSVM triplet blocks: O(block nnz) transient
+        memory, and the ONLY (rows x cols) allocation is the stored bin
+        matrix itself — (slots, N) when bundling engaged. Implicit
+        zeros are never touched: each stored row is pre-filled with its
+        feature's zero bin (bundle members have zero-bin 0 by the
+        plan's candidate rule), so only nonzero entries are binned.
+        The reference's equivalent storage is the delta-encoded nonzero
+        list of src/io/sparse_bin.hpp:17-331."""
+        cfg = self.config
+        f_used = len(mappers)
+        if plan is None:
+            dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
+                     else np.uint16)
+            check_bins_budget(f_used, n_local, np.dtype(dtype).itemsize,
+                              "Dense (unbundled) sparse-LibSVM load")
+            bins = np.zeros((f_used, n_local), dtype=dtype)
+            members = None
+            for u, m in enumerate(mappers):
+                b0 = int(m.value_to_bin(np.zeros(1))[0])
+                if b0:
+                    bins[u, :] = b0
+        else:
+            dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
+                     else np.uint16)
+            check_bins_budget(plan.num_slots, n_local,
+                              np.dtype(dtype).itemsize,
+                              "Bundled sparse-LibSVM load")
+            bins = np.zeros((plan.num_slots, n_local), dtype=dtype)
+            members = np.bincount(plan.feat_slot, minlength=plan.num_slots)
+            for u, m in enumerate(mappers):
+                s = int(plan.feat_slot[u])
+                if members[s] == 1:
+                    b0 = int(m.value_to_bin(np.zeros(1))[0])
+                    if b0:
+                        bins[s, :] = b0
+        label = np.empty(n_local, dtype=np.float32)
+        conflicts = 0
+        from .streaming import iter_sparse_blocks, prefetch_blocks
+        for start, lab, rows, cols, vals in prefetch_blocks(
+                iter_sparse_blocks(filename, cfg.has_header)):
+            end = start + len(lab)
+            if start >= hi:
+                break  # past this rank's range: skip the rest
+            s0, e0 = max(start, lo), min(end, hi)
+            if e0 <= s0:
+                continue  # block before this rank's range
+            rlo, rhi = s0 - start, e0 - start
+            label[s0 - lo:e0 - lo] = lab[rlo:rhi]
+            keep = (rows >= rlo) & (rows < rhi)
+            r = rows[keep] - rlo + (s0 - lo)   # local row positions
+            c = cols[keep]
+            # aligned (valid) files may mention feature ids past the
+            # train set's feature space: those are simply unused
+            u_arr = np.where(c < len(used_map),
+                             used_map[np.minimum(c, len(used_map) - 1)],
+                             np.int32(-1))
+            v = np.nan_to_num(vals[keep], nan=0.0)
+            used = u_arr >= 0
+            r, v, u_arr = r[used], v[used], u_arr[used]
+            # group entries by used feature, ASCENDING u: bundle
+            # conflicts keep the first (lowest-u) member's bin, the
+            # same rule as the dense routes
+            order = np.argsort(u_arr, kind="stable")
+            r, v, u_arr = r[order], v[order], u_arr[order]
+            bounds = np.flatnonzero(np.diff(u_arr)) + 1
+            starts = np.concatenate([[0], bounds])
+            ends = np.concatenate([bounds, [len(u_arr)]])
+            for g0, g1 in zip(starts, ends):
+                if g1 <= g0:
+                    continue
+                u = int(u_arr[g0])
+                b = mappers[u].value_to_bin(v[g0:g1]).astype(np.int64)
+                rr = r[g0:g1]
+                if plan is None:
+                    bins[u, rr] = b.astype(dtype)
+                    continue
+                s = int(plan.feat_slot[u])
+                if members[s] == 1:
+                    bins[s, rr] = b.astype(dtype)
+                    continue
+                off = int(plan.feat_offset[u])
+                nz = b > 0
+                rnz = rr[nz]
+                clash = bins[s, rnz] != 0
+                conflicts += int(clash.sum())
+                w = ~clash
+                bins[s, rnz[w]] = (b[nz][w] + off).astype(dtype)
+        if conflicts:
+            Log.warning("Feature bundling: %d conflicting cells kept "
+                        "their first member's bin", conflicts)
+        return bins, label
+
+    def _load_sparse_aligned(self, filename, train_ds) -> CoreDataset:
+        """O(nnz) valid-set LibSVM load with the TRAIN mappers + bundle
+        plan (the sparse analog of the dense aligned path below)."""
+        from .streaming import count_rows
+        cfg = self.config
+        # only the row count is needed here (the train set fixed the
+        # feature space) — skip scan_file's max-feature-id token pass
+        n = count_rows(filename, cfg.has_header)
+        if n == 0:
+            Log.fatal("Data file %s is empty", str(filename))
+        bins, label = self._stream_sparse_libsvm(
+            filename, train_ds.bin_mappers, train_ds.used_feature_map,
+            train_ds.bundle_plan, n, 0, n)
+        ds = CoreDataset()
+        ds.num_total_features = train_ds.num_total_features
+        ds.label_idx = train_ds.label_idx
+        ds.feature_names = train_ds.feature_names
+        ds.bin_mappers = train_ds.bin_mappers
+        ds.used_feature_map = train_ds.used_feature_map
+        ds.real_feature_idx = train_ds.real_feature_idx
+        ds.bundle_plan = train_ds.bundle_plan
+        ds.bins = bins.astype(train_ds.bins.dtype, copy=False)
+        meta = Metadata(n)
+        meta.set_label(label)
+        meta.load_side_files(filename)
+        ds.metadata = meta
         return ds
 
     # --------------------------------------------------------- from matrix
@@ -687,16 +882,20 @@ class DatasetLoader:
         from .bundling import plan_bundles, build_stored_matrix
         plan = None
         if cfg.is_enable_sparse:
-            sample_bins = np.stack(
-                [mappers[used_map[j]].value_to_bin(sample_col(j))
-                 for j in real_idx], axis=0)
-            plan = plan_bundles(mappers, sample_bins, enable=True)
+            # per-column callable: planning a wide-sparse input never
+            # builds the dense (F, sample) bins stack
+            plan = plan_bundles(
+                mappers,
+                lambda u: mappers[u].value_to_bin(sample_col(real_idx[u])),
+                enable=True)
             if plan.is_identity:
                 plan = None
 
         if plan is None:
             dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
                      else np.uint16)
+            check_bins_budget(len(real_idx), n, np.dtype(dtype).itemsize,
+                              "Dense (unbundled) dataset construction")
             ds.bins = np.stack(_bin_columns_threaded(
                 lambda u: mappers[u].value_to_bin(
                     src.col(real_idx[u])).astype(dtype),
@@ -704,6 +903,8 @@ class DatasetLoader:
         else:
             dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
                      else np.uint16)
+            check_bins_budget(plan.num_slots, n, np.dtype(dtype).itemsize,
+                              "Bundled dataset construction")
             ds.bins = build_stored_matrix(
                 plan,
                 lambda u: mappers[u].value_to_bin(src.col(real_idx[u])),
@@ -729,6 +930,23 @@ class DatasetLoader:
             Log.fatal("Validation data has fewer features than training data")
         real = ref_ds.real_feature_idx
         mappers = ref_ds.bin_mappers
+        if ref_ds.bundle_plan is not None:
+            # valid sets share the train plan so a wide-sparse valid set
+            # stores the same O(slots x N) matrix (scoring and traversal
+            # decode slots exactly like the train set's)
+            from .bundling import build_stored_matrix
+            check_bins_budget(ref_ds.bundle_plan.num_slots, src.n,
+                              ref_ds.bins.dtype.itemsize,
+                              "Bundled aligned (valid set) construction")
+            ds.bins = build_stored_matrix(
+                ref_ds.bundle_plan,
+                lambda u: mappers[u].value_to_bin(src.col(real[u])),
+                ref_ds.bins.dtype)
+            ds.bundle_plan = ref_ds.bundle_plan
+            ds.metadata = meta
+            return ds
+        check_bins_budget(len(mappers), src.n, ref_ds.bins.dtype.itemsize,
+                          "Aligned (valid set) dataset construction")
         cols = _bin_columns_threaded(
             lambda u: mappers[u].value_to_bin(
                 src.col(real[u])).astype(ref_ds.bins.dtype),

@@ -22,6 +22,20 @@ from .parser import libsvm_pairs, NA_VALUES
 DEFAULT_BLOCK_ROWS = 1 << 16
 
 
+def count_rows(path, has_header):
+    """Non-empty line count only — no tokenization (text_reader.h
+    CountLine). For callers that don't need scan_file's LibSVM
+    max-feature-id discovery pass."""
+    n = 0
+    with open(path, "r") as f:
+        if has_header:
+            next(f, None)
+        for line in f:
+            if line.strip():
+                n += 1
+    return n
+
+
 def scan_file(path, fmt, has_header):
     """First pass: row count + (names, num_cols). For LibSVM also
     discovers the column count (max index + 1) — text_reader.h CountLine
@@ -154,6 +168,83 @@ def prefetch_blocks(block_iter, depth=2):
         t.join(timeout=10)
     if err:
         raise err[0]
+
+
+def iter_sparse_blocks(path, has_header, block_rows=DEFAULT_BLOCK_ROWS):
+    """LibSVM second-pass iterator in O(block nnz) memory: yields
+    (row_start, labels (b,) f64, rows (nnz,) i64 block-local,
+    cols (nnz,) i64 feature ids, vals (nnz,) f64). The dense-block
+    iterator materializes (b, num_cols) floats — at news20-like widths
+    that is GBs per block; this is the O(nnz) route the reference's
+    sparse row parser feeds (src/io/parser.hpp LibSVM + sparse_bin.hpp
+    push path)."""
+    labels = []
+    rows, cols, vals = [], [], []
+    start = 0
+    fill = 0
+    with open(path, "r") as f:
+        if has_header:
+            next(f, None)
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            for idx, val in libsvm_pairs(parts[1:]):
+                rows.append(fill)
+                cols.append(idx)
+                vals.append(val)
+            fill += 1
+            if fill == block_rows:
+                yield (start, np.asarray(labels, dtype=np.float64),
+                       np.asarray(rows, dtype=np.int64),
+                       np.asarray(cols, dtype=np.int64),
+                       np.asarray(vals, dtype=np.float64))
+                start += fill
+                fill = 0
+                labels, rows, cols, vals = [], [], [], []
+    if fill:
+        yield (start, np.asarray(labels, dtype=np.float64),
+               np.asarray(rows, dtype=np.int64),
+               np.asarray(cols, dtype=np.int64),
+               np.asarray(vals, dtype=np.float64))
+
+
+def collect_sample_csc(path, has_header, num_feats, sample_idx,
+                       block_rows=DEFAULT_BLOCK_ROWS):
+    """Round one for wide LibSVM: gather the sampled rows as CSC
+    (colptr, indices-into-sample, vals) + labels, in O(sample nnz)
+    memory — the dense collect_sample_rows would need
+    (sample, num_cols) floats."""
+    sample_idx = np.asarray(sample_idx, dtype=np.int64)
+    labels = np.zeros(len(sample_idx), dtype=np.float64)
+    parts_c, parts_r, parts_v = [], [], []
+    for start, lab, rows, cols, vals in iter_sparse_blocks(
+            path, has_header, block_rows):
+        lo = np.searchsorted(sample_idx, start)
+        hi = np.searchsorted(sample_idx, start + len(lab))
+        if hi <= lo:
+            continue
+        want = sample_idx[lo:hi] - start          # block-local row ids
+        labels[lo:hi] = lab[want]
+        # map block rows -> sample positions; -1 = not sampled
+        pos = np.full(len(lab), -1, dtype=np.int64)
+        pos[want] = np.arange(lo, hi)
+        keep = pos[rows] >= 0
+        parts_r.append(pos[rows[keep]])
+        parts_c.append(cols[keep])
+        parts_v.append(vals[keep])
+    rows = (np.concatenate(parts_r) if parts_r
+            else np.zeros(0, dtype=np.int64))
+    cols = (np.concatenate(parts_c) if parts_c
+            else np.zeros(0, dtype=np.int64))
+    vals = (np.concatenate(parts_v) if parts_v
+            else np.zeros(0, dtype=np.float64))
+    order = np.argsort(cols, kind="stable")
+    counts = np.bincount(cols, minlength=num_feats)
+    colptr = np.concatenate([[0], np.cumsum(counts)])
+    return labels, colptr, rows[order], vals[order]
 
 
 def collect_sample_rows(path, fmt, has_header, num_cols, sample_idx,

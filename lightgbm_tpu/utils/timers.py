@@ -43,6 +43,11 @@ class PhaseTimers:
         self.acc.clear()
         self.cnt.clear()
 
+    def snapshot(self):
+        """{phase: total_seconds} for machine-readable reporting (the
+        bench emits this in its result JSON)."""
+        return {k: round(v, 3) for k, v in self.acc.items()}
+
     def report(self):
         """One line per phase, largest first."""
         lines = []

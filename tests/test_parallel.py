@@ -81,6 +81,24 @@ def test_feature_parallel_matches_serial(data):
     np.testing.assert_allclose(ps, pf, atol=1e-5)
 
 
+def test_feature_parallel_psum_fallback_matches_serial(data):
+    """Above REPLICATED_BINS_MAX_BYTES the FP learner broadcasts the
+    owner shard's split column with a psum instead of reading a
+    replicated copy (learners.py split_col); force the threshold to 0
+    so the fallback path is what's tested."""
+    import lightgbm_tpu.parallel.learners as L
+    X, y = data
+    gs = _train(_cfg("serial"), X, y)
+    old = L.FeatureParallelTreeLearner.REPLICATED_BINS_MAX_BYTES
+    L.FeatureParallelTreeLearner.REPLICATED_BINS_MAX_BYTES = 0
+    try:
+        gf = _train(_cfg("feature"), X, y)
+    finally:
+        L.FeatureParallelTreeLearner.REPLICATED_BINS_MAX_BYTES = old
+    assert gf.tree_learner._bins_replicated is None
+    _assert_identical_trees(gs, gf)
+
+
 def test_voting_parallel_accuracy(data):
     X, y = data
     gv = _train(_cfg("voting"), X, y, rounds=20)
@@ -111,10 +129,15 @@ def test_data_parallel_partitioned_matches_serial_partitioned():
          + 0.05 * rng.randn(n) > 0.7).astype(np.float32)
 
     def cfg(learner):
-        return Config.from_params({
+        # num_machines > 1 keeps the parallel learner through
+        # check_param_conflict (one machine coerces to serial)
+        c = Config.from_params({
             "objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
             "tree_learner": learner, "verbose": -1, "metric_freq": 0,
-            "partitioned_build": "true"})
+            "partitioned_build": "true",
+            "num_machines": 1 if learner == "serial" else 4})
+        assert c.tree_learner == learner
+        return c
 
     g_serial = _train(cfg("serial"), X, y, rounds=5)
     g_dp = _train(cfg("data"), X, y, rounds=5)

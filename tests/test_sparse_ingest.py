@@ -1,0 +1,193 @@
+"""O(nnz) sparse ingestion: wide LibSVM / CSC inputs never materialize
+the dense F x N block.
+
+Reference capability being replaced: sparse bin storage
+(src/io/sparse_bin.hpp:17-331, auto-selected at sparse_rate >= 0.8,
+src/io/bin.cpp:291-302) lets the reference load news20-shaped data in
+O(nnz) memory. Here the same capacity comes from EFB slots + O(nnz)
+streaming (io/streaming.py iter_sparse_blocks / collect_sample_csc,
+dataset.py _stream_sparse_libsvm), with a loud budget guard
+(check_bins_budget) where the reference would quietly stay sparse.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import CscColumns, DatasetLoader
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _onehot_groups(rng, n, groups, width, binary=True):
+    """`groups` mutually-exclusive one-hot blocks of `width` columns:
+    the classic EFB shape (each block bundles into one slot). Binary
+    indicators keep 2 bins per column so whole groups share slots;
+    binary=False uses continuous nonzeros (many bins per column)."""
+    cols = []
+    for _ in range(groups):
+        pick = rng.randint(0, width, size=n)
+        block = np.zeros((n, width), np.float64)
+        # grid values are exact in f32, f64 AND %.10g text, so the
+        # file-roundtrip comparison is bit-identical
+        block[np.arange(n), pick] = (1.0 if binary
+                                     else rng.randint(1, 100, n) / 64.0)
+        cols.append(block)
+    return np.concatenate(cols, axis=1)
+
+
+def _write_libsvm(path, x, y):
+    with open(path, "w") as f:
+        for i in range(len(y)):
+            nz = np.nonzero(x[i])[0]
+            pairs = " ".join(f"{j}:{x[i, j]:.10g}" for j in nz)
+            f.write(f"{y[i]:g} {pairs}\n")
+
+
+@pytest.fixture(scope="module")
+def wide_data():
+    rng = np.random.RandomState(5)
+    n = 1200
+    sparse = _onehot_groups(rng, n, groups=38, width=10)  # 380 binary cols
+    # a couple of continuous sparse columns (many bins) in the mix
+    sparse = np.concatenate(
+        [sparse, _onehot_groups(rng, n, 2, 12, binary=False)], axis=1)
+    dense = rng.randint(-128, 128, (n, 3)) / 64.0
+    neg = -1.0 - rng.randint(0, 64, (n, 1)) / 64.0   # zero bins HIGH
+    x = np.concatenate([sparse, dense, neg], axis=1)
+    y = (sparse[:, 0] + 0.5 * dense[:, 0] > 0.6).astype(np.float64)
+    return x, y
+
+
+def test_sparse_libsvm_matches_dense_route(wide_data, tmp_path):
+    """The triplet-streaming LibSVM route must produce bins identical
+    to the in-memory dense construction of the same logical matrix —
+    including features whose zero bin is NOT 0 (the all-negative
+    column exercises the prefill path)."""
+    x, y = wide_data
+    path = tmp_path / "wide.libsvm"
+    _write_libsvm(path, x, y)
+    cfg_file = Config.from_params({"use_two_round_loading": True,
+                                   "enable_load_from_binary_file": False})
+    d_file = DatasetLoader(cfg_file).load_from_file(str(path))
+    cfg_mem = Config.from_params({})
+    d_mem = DatasetLoader(cfg_mem).construct_from_matrix(
+        x.astype(np.float32), label=y)
+    assert d_file.bundle_plan is not None          # EFB engaged
+    assert d_file.bins.shape[0] <= 60              # 408 virtual features
+    np.testing.assert_array_equal(d_file.bins, d_mem.bins)
+    np.testing.assert_array_equal(np.asarray(d_file.metadata.label),
+                                  np.asarray(d_mem.metadata.label))
+
+
+def test_wide_sparse_trains(wide_data, tmp_path):
+    """End-to-end: wide LibSVM -> bundled dataset -> trained booster."""
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    x, y = wide_data
+    path = tmp_path / "wide_train.libsvm"
+    _write_libsvm(path, x, y)
+    cfg = Config.from_params({
+        "objective": "binary", "num_leaves": 7, "num_iterations": 3,
+        "metric_freq": 0, "verbose": -1, "use_two_round_loading": True,
+        "enable_load_from_binary_file": False, "min_data_in_leaf": 5})
+    ds = DatasetLoader(cfg).load_from_file(str(path))
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    b = GBDT()
+    b.init(cfg, ds, obj, [])
+    for _ in range(3):
+        b.train_one_iter(is_eval=False)
+    assert len(b.models) == 3
+    assert b.models[0].num_leaves > 1              # something was learned
+
+
+def test_csc_wide_sparse_is_onnz(monkeypatch):
+    """A CSC column source at news20-ish width must construct without
+    ever allocating a dense F x N block: set the budget BELOW the dense
+    matrix size — bundled construction must still succeed."""
+    rng = np.random.RandomState(9)
+    n, groups, width = 800, 500, 10            # F = 5000 virtual
+    x = _onehot_groups(rng, n, groups, width)
+    f = x.shape[1]
+    # dense (F, N) uint8 would be 4.0 MB; budget 2 MB forces O(nnz)
+    monkeypatch.setenv("LIGHTGBM_TPU_MAX_BINS_GB",
+                       str(2 / 1024.0))
+    indptr = [0]
+    indices, vals = [], []
+    for i in range(n):
+        nz = np.nonzero(x[i])[0]
+        indices.extend(nz.tolist())
+        vals.extend(x[i, nz].tolist())
+        indptr.append(len(indices))
+    src = CscColumns.from_csr(np.asarray(indptr), np.asarray(indices),
+                              np.asarray(vals), f)
+    y = (x[:, 0] > 0).astype(np.float32)
+    cfg = Config.from_params({"objective": "binary", "verbose": -1})
+    ds = DatasetLoader(cfg).construct_from_matrix(src, label=y)
+    assert ds.bundle_plan is not None
+    assert ds.bins.shape[0] * ds.bins.shape[1] * ds.bins.dtype.itemsize \
+        <= 2 << 20
+    assert ds.num_features == f
+
+
+def test_budget_guard_fires(monkeypatch):
+    """Unbundleable wide data over budget must fail LOUDLY, naming the
+    bundling knob — not OOM."""
+    rng = np.random.RandomState(2)
+    n, f = 400, 600
+    x = rng.randn(n, f).astype(np.float32)     # dense: nothing bundles
+    y = (x[:, 0] > 0).astype(np.float32)
+    monkeypatch.setenv("LIGHTGBM_TPU_MAX_BINS_GB", str(0.1 / 1024.0))
+    cfg = Config.from_params({"objective": "binary", "verbose": -1})
+    with pytest.raises(LightGBMError, match="is_enable_sparse"):
+        DatasetLoader(cfg).construct_from_matrix(x, label=y)
+
+
+def test_aligned_libsvm_valid_file_streams_sparse(wide_data, tmp_path):
+    """A LibSVM valid FILE binned against a bundled train set takes the
+    O(nnz) aligned route: same stored shape, same slot decode, bins
+    equal to in-memory aligned construction."""
+    x, y = wide_data
+    xtr, ytr = x[:900], y[:900]
+    xva, yva = x[900:], y[900:]
+    tr_path = tmp_path / "tr.libsvm"
+    va_path = tmp_path / "va.libsvm"
+    _write_libsvm(tr_path, xtr, ytr)
+    _write_libsvm(va_path, xva, yva)
+    cfg = Config.from_params({"use_two_round_loading": True,
+                              "enable_load_from_binary_file": False})
+    loader = DatasetLoader(cfg)
+    d_tr = loader.load_from_file(str(tr_path))
+    assert d_tr.bundle_plan is not None
+    d_va = loader.load_from_file_align_with_other_dataset(
+        str(va_path), d_tr)
+    assert d_va.bundle_plan is d_tr.bundle_plan
+    assert d_va.bins.shape == (d_tr.bins.shape[0], len(yva))
+    d_va_mem = DatasetLoader(Config.from_params({})).construct_from_matrix(
+        xva.astype(np.float32), label=yva, reference=d_tr)
+    np.testing.assert_array_equal(d_va.bins, d_va_mem.bins)
+
+
+def test_valid_set_shares_bundle_plan(wide_data):
+    """A valid set built against a bundled train set stores the same
+    O(slots x N) matrix (not the dense virtual matrix) and scores
+    through the same slot decode."""
+    x, y = wide_data
+    import lightgbm_tpu as lgb
+    xtr, ytr = x[:900].astype(np.float32), y[:900]
+    xva, yva = x[900:].astype(np.float32), y[900:]
+    dtr = lgb.Dataset(xtr, ytr)
+    dva = lgb.Dataset(xva, yva, reference=dtr)
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                   "metric": "binary_logloss", "min_data_in_leaf": 5},
+                  dtr, num_boost_round=3, valid_sets=[dva])
+    tr_ds = dtr.construct()._core
+    va_ds = dva.construct()._core
+    assert tr_ds.bundle_plan is not None
+    assert va_ds.bundle_plan is tr_ds.bundle_plan
+    assert va_ds.bins.shape[0] == tr_ds.bins.shape[0]
+    # predictions on the valid rows come out finite and discriminative
+    p = b.predict(xva)
+    assert np.isfinite(p).all()
