@@ -899,6 +899,9 @@ class GBDT:
     # reference parallelizes prediction with OpenMP, predictor.hpp:82-130;
     # here rows AND trees vectorize on device, class reduction on the MXU)
     DEVICE_PREDICT_CELLS = 20_000_000
+    # single-dispatch (lax.map) predict when the padded f32 input fits
+    # this budget; beyond it, per-block dispatches bound device memory
+    DEVICE_PREDICT_INPUT_MAX = 2 << 30
     _PREDICT_BLOCK = 65_536
     # host-path (rows x trees) cells per traversal block (peak memory)
     _HOST_TRAVERSE_CELLS = 4_000_000
@@ -982,6 +985,27 @@ class GBDT:
              == np.arange(self.num_class)[None, :]).astype(np.float32))
         n = x.shape[0]
         block = self._PREDICT_BLOCK
+        nb = -(-n // block)
+        # bucket the block count (round up to a multiple of the 2nd
+        # MSB) so distinct batch sizes share O(log N) compiled map
+        # shapes instead of one trace+compile per size — through the
+        # tunnel a recompile costs more than the dispatches saved.
+        # Worst-case padding overhead ~25% of traversal compute.
+        if nb > 4:
+            step = 1 << max(nb.bit_length() - 3, 0)
+            nb = -(-nb // step) * step
+        f = x.shape[1]
+        if nb > 1 and nb * block * f * 4 <= self.DEVICE_PREDICT_INPUT_MAX:
+            # whole matrix in ONE dispatch: lax.map over row blocks
+            # (168 per-block RPCs at 11M rows through the remote-TPU
+            # tunnel cost more than the traversal itself)
+            xall = np.zeros((nb * block, f), dtype=np.float32)
+            xall[:n] = x
+            out = self._predict_map_device(
+                jnp.asarray(xall).reshape(nb, block, f), sf, thr, cat,
+                lc, rc, lv, node0, cls_onehot, depth)
+            return np.asarray(out).reshape(nb * block, -1)[:n] \
+                .astype(np.float64)
         outs = []
         for s in range(0, n, block):
             xb = np.asarray(x[s:s + block], dtype=np.float32)
@@ -993,6 +1017,18 @@ class GBDT:
                 cls_onehot, depth))
         host = np.concatenate([np.asarray(o) for o in outs], axis=0)[:n]
         return host.astype(np.float64)
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnums=(9,))
+    def _predict_map_device(xblocks, sf, thr, cat, lc, rc, lv, node0,
+                            cls_onehot, depth):
+        """(NB, B, F) -> (NB, B, K): sequential lax.map over the same
+        per-block traversal — one compiled program, one dispatch."""
+        def one(xb):
+            # nested jit traces inline
+            return GBDT._predict_block_device(
+                xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot, depth)
+        return jax.lax.map(one, xblocks)
 
     def predict_raw(self, x, num_iteration=-1):
         """Raw scores for (N, num_total_features) raw values -> (N, K).

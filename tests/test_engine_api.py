@@ -264,12 +264,18 @@ def test_device_predict_matches_host():
         host = b.gbdt.predict_raw(xq)            # below threshold: host path
         gb = b.gbdt
         old = gb.DEVICE_PREDICT_CELLS
+        old_blk, old_max = gb._PREDICT_BLOCK, gb.DEVICE_PREDICT_INPUT_MAX
         try:
             gb.DEVICE_PREDICT_CELLS = 1          # force device path
-            dev = gb.predict_raw(xq)
+            gb._PREDICT_BLOCK = 128              # multiple blocks
+            dev_map = gb.predict_raw(xq)         # single-dispatch lax.map
+            gb.DEVICE_PREDICT_INPUT_MAX = 0      # per-block dispatch loop
+            dev_loop = gb.predict_raw(xq)
         finally:
             gb.DEVICE_PREDICT_CELLS = old
-        np.testing.assert_allclose(dev, host, rtol=1e-5, atol=1e-6)
+            gb._PREDICT_BLOCK, gb.DEVICE_PREDICT_INPUT_MAX = old_blk, old_max
+        np.testing.assert_allclose(dev_map, host, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(dev_loop, host, rtol=1e-5, atol=1e-6)
 
 
 def test_predict_cache_invalidated_by_rollback():
