@@ -68,6 +68,33 @@ class _VirtualBinsView:
         return np.where((sc > off) & (sc <= off + nb - 1), sc - off, 0)
 
 
+AUTO_STREAM_MIN_FEATS = 1024
+
+
+def _libsvm_looks_wide(filename, has_header):
+    """Cheap probe: is this a LibSVM file whose feature ids reach past
+    AUTO_STREAM_MIN_FEATS within the first 1000 data lines? Wide sparse
+    files auto-route to the O(nnz) streaming loader; narrow ones keep
+    the (also-correct) in-memory path."""
+    from .parser import detect_format, libsvm_pairs
+    try:
+        if detect_format(filename) != "libsvm":
+            return False
+        with open(filename, "r") as f:
+            if has_header:
+                next(f, None)
+            for _, line in zip(range(1000), f):
+                parts = line.split()
+                if len(parts) < 2:
+                    continue
+                for idx, _ in libsvm_pairs(parts[1:]):
+                    if idx + 1 > AUTO_STREAM_MIN_FEATS:
+                        return True
+    except Exception:   # unreadable / binary / undecodable: not libsvm
+        return False
+    return False
+
+
 def check_bins_budget(rows, cols, itemsize, what):
     """Loud guard before allocating a stored bin matrix: a wide sparse
     dataset that failed to bundle would silently materialize the dense
@@ -351,12 +378,13 @@ class DatasetLoader:
                     ds = CoreDataset.load_binary(cand)
                 except Exception:
                     continue  # not a binary cache; fall through
-                if ds.bundle_plan is not None and (
-                        not cfg.is_enable_sparse
-                        or cfg.tree_learner == "feature"):
-                    # cache was built with bundling but this run can't
-                    # use it — rebuild from text (WITHOUT overwriting the
-                    # cache, so the original config keeps its bundling)
+                if ds.bundle_plan is not None and not cfg.is_enable_sparse:
+                    # cache was built with bundling but this run
+                    # disabled it — rebuild from text (WITHOUT
+                    # overwriting the cache, so the original config
+                    # keeps its bundling). (Feature-parallel handles
+                    # bundled datasets since parallel/learners.py grew
+                    # per-shard slot maps — no learner restriction.)
                     Log.warning("Binary cache %s contains a bundled "
                                 "dataset incompatible with this config; "
                                 "rebuilding from text", cand)
@@ -369,8 +397,15 @@ class DatasetLoader:
         # two-round streaming path: peak memory O(block), the full float
         # matrix never materializes (dataset_loader.cpp:505-610). Continued
         # training needs raw values for init scores, so it keeps the
-        # in-memory path.
-        if cfg.use_two_round_loading and self.predict_fun is None:
+        # in-memory path. Wide LibSVM auto-streams even without
+        # use_two_round_loading: the dense parse would materialize the
+        # (N, F) float block the O(nnz) route exists to avoid (the
+        # reference gets this from per-feature sparse bins,
+        # sparse_bin.hpp; here the format sniff stands in for its
+        # sparse_rate auto-selection, bin.cpp:291-302).
+        if self.predict_fun is None and (
+                cfg.use_two_round_loading
+                or _libsvm_looks_wide(filename, cfg.has_header)):
             ds = self._load_two_round(filename, rank, num_machines)
             if ds.global_num_data is not None:
                 if cfg.is_save_binary_file:

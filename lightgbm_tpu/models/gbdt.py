@@ -986,11 +986,12 @@ class GBDT:
         n = x.shape[0]
         block = self._PREDICT_BLOCK
         nb = -(-n // block)
-        # bucket the block count (round up to a multiple of the 2nd
-        # MSB) so distinct batch sizes share O(log N) compiled map
-        # shapes instead of one trace+compile per size — through the
-        # tunnel a recompile costs more than the dispatches saved.
-        # Worst-case padding overhead ~25% of traversal compute.
+        # bucket the block count (round up to a multiple of the
+        # 3rd-highest bit) so distinct batch sizes share O(log N)
+        # compiled map shapes instead of one trace+compile per size —
+        # through the tunnel a recompile costs more than the dispatches
+        # saved. Worst-case padding overhead ~12.5% of traversal
+        # compute.
         if nb > 4:
             step = 1 << max(nb.bit_length() - 3, 0)
             nb = -(-nb // step) * step
