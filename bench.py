@@ -266,7 +266,8 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
     load_s = time.time() - t0
     _mark(f"dataset constructed in {load_s:.2f}s")
-    del x
+    # x is kept (host RAM is ample): the predict phase reuses it,
+    # saving an ~87s 11M-row regeneration inside the HIGGS budget
 
     objective = create_objective(cfg.objective, cfg)
     objective.init(ds.metadata, ds.num_data)
@@ -309,7 +310,7 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     auc_metric = create_metric("auc", cfg)
     auc_metric.init(ds.metadata, ds.num_data)
     auc = float(auc_metric.eval(booster.get_training_score())[0])
-    return train_s, auc, booster, load_s, TIMERS.snapshot()
+    return train_s, auc, booster, load_s, TIMERS.snapshot(), x
 
 
 def run_child():
@@ -331,9 +332,14 @@ def run_child():
     import jax
     if os.environ.get("BENCH_CHILD_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    # persistent compilation cache: a prior run's compiled programs
+    # (same shapes/config) skip the 10-60s XLA compile — precious when
+    # the tunnel's live windows are short
+    jax.config.update("jax_compilation_cache_dir", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
     n_rows = int(os.environ["BENCH_CHILD_ROWS"])
     n_iters = int(os.environ.get("BENCH_CHILD_ITERS", NUM_ITERATIONS))
-    train_s, auc, booster, load_s, phases = train_once(n_rows, n_iters)
+    train_s, auc, booster, load_s, phases, x_raw = train_once(n_rows, n_iters)
     # the TRAIN result prints FIRST: the optional predict timing below
     # must not be able to cost us the primary measurement (watchdog)
     res = {"time_s": round(train_s, 3), "auc": round(auc, 5),
@@ -345,18 +351,24 @@ def run_child():
     if n_rows >= 100_000 and train_s / max(n_iters, 1) < 1e-3:
         res["memo_suspect"] = True
     print("CHILD_RESULT " + json.dumps(res), flush=True)
-    if not os.environ.get("BENCH_SKIP_PREDICT"):
-        # batch prediction over the full matrix (device traversal above
-        # GBDT.DEVICE_PREDICT_CELLS; reference predictor.hpp:82-130)
-        _mark("regenerating raw matrix for predict timing")
-        x2, _ = make_data(n_rows)
-        _mark(f"predicting {n_rows} rows x {len(booster.models)} trees")
-        t0 = time.time()
-        booster.predict(x2)
-        predict_s = time.time() - t0
-        _mark(f"predict done in {predict_s:.2f}s")
-        print("CHILD_PREDICT " + json.dumps(
-            {"predict_s": round(predict_s, 3)}), flush=True)
+    if os.environ.get("BENCH_SKIP_PREDICT"):
+        del x_raw   # never used on this path; drop ~1.2 GB at 11M rows
+        return
+    # batch prediction over the full matrix (device traversal above
+    # GBDT.DEVICE_PREDICT_CELLS; reference predictor.hpp:82-130).
+    # Memo-bust note: x is identical across runs (seed 42), but the
+    # model arrays are predict-dispatch INPUTS and derive from the
+    # memo-busted labels, so the dispatch is unique per run; the
+    # suspect check below backstops that reasoning.
+    _mark(f"predicting {n_rows} rows x {len(booster.models)} trees")
+    t0 = time.time()
+    booster.predict(x_raw)
+    predict_s = time.time() - t0
+    _mark(f"predict done in {predict_s:.2f}s")
+    pred = {"predict_s": round(predict_s, 3)}
+    if n_rows >= 1_000_000 and predict_s < 0.05:
+        pred["predict_memo_suspect"] = True
+    print("CHILD_PREDICT " + json.dumps(pred), flush=True)
 
 
 def measure(n_rows, n_iters, timeout_s, force_cpu=False,
@@ -512,6 +524,8 @@ def _format_result(res, reason):
         result["phases"] = res["phases"]
     if res.get("memo_suspect"):
         result["memo_suspect"] = True
+    if res.get("predict_memo_suspect"):
+        result["predict_memo_suspect"] = True
     return result
 
 
