@@ -1,0 +1,47 @@
+#!/bin/bash
+# One live-tunnel measurement session, highest-value first. Run the
+# moment the relay revives (observed windows are ~25 min; see
+# BASELINE.md round 5). Logs land in /tmp/tpu_session_<ts>/.
+#
+#   bash tools/tpu_session.sh
+#
+# Order: (1) bench primary 1M line + HIGGS 11M (the north star —
+# BENCH-formatted JSON, vs_baseline vs the measured 22.2s/411.2s),
+# (2) microbench primitive roofline + fused s/iter for both builders.
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+OUT=/tmp/tpu_session_$TS
+mkdir -p "$OUT"
+echo "[tpu_session] logs in $OUT"
+
+listening() {
+  ss -tln 2>/dev/null | grep -q "127.0.0.1:808" && return 0
+  ss -tln 2>/dev/null | grep -q "127.0.0.1:811"
+}
+
+if ! listening; then
+  echo "[tpu_session] relay not listening; abort"
+  exit 1
+fi
+
+# 1) bench: generous budgets (a manual session is not the driver's
+# 1500s box); block-iteration reuse keeps one compiled scan
+BENCH_GLOBAL_DEADLINE=3600 BENCH_PRIMARY_TIMEOUT=1500 \
+BENCH_HIGGS_TIMEOUT=1800 \
+  timeout 3700 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
+echo "[tpu_session] bench rc=$? last line:"
+tail -1 "$OUT/bench.json" || true
+
+if ! listening; then
+  echo "[tpu_session] relay died after bench; logs in $OUT"
+  exit 0
+fi
+
+# 2) microbench (variant-input chains + roofline columns)
+timeout 1800 python tools/microbench.py 1000000 20 \
+  >"$OUT/microbench.log" 2>&1
+echo "[tpu_session] microbench rc=$?"
+tail -20 "$OUT/microbench.log" || true
+
+echo "[tpu_session] done; record numbers in BASELINE.md"
