@@ -157,6 +157,13 @@ class Config:
     num_iteration_predict: int = -1
     is_pre_partition: bool = False
     is_enable_sparse: bool = True
+    # EFB conflict tolerance: fraction of rows a bundle may have in
+    # conflict (0.0 = only perfectly-exclusive features share a slot;
+    # conflicting cells keep the first member's bin). The reference v0
+    # predates EFB — its per-feature sparse bins tolerate any overlap
+    # (sparse_bin.hpp); this knob recovers that capacity for
+    # NEAR-exclusive wide data.
+    max_conflict_rate: float = 0.0
     use_two_round_loading: bool = False
     is_save_binary_file: bool = False
     enable_load_from_binary_file: bool = True
@@ -346,6 +353,8 @@ class Config:
         check(self.early_stopping_round >= 0, "early_stopping_round should be >= 0")
         check(0.0 <= self.drop_rate <= 1.0, "drop_rate in [0, 1]")
         check(self.num_machines >= 1, "num_machines should be >= 1")
+        check(0.0 <= self.max_conflict_rate < 1.0,
+              "max_conflict_rate in [0, 1)")
         check(self.num_class >= 1, "num_class should be >= 1")
         check(self.max_position > 0, "max_position should be > 0")
 

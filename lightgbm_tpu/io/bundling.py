@@ -31,13 +31,18 @@ MAX_SLOT_BINS = 256      # keep stored histogram width = one bin tile
 
 
 class BundlePlan:
-    """Static description: stored slot + bin offset per virtual feature."""
+    """Static description: stored slot + bin offset per virtual feature.
+    `conflict_rate` records the max_conflict_rate the plan was built
+    with, so a binary cache holding a tolerant (approximate) plan is
+    not silently reused by an exact-bundling config."""
 
-    def __init__(self, feat_slot, feat_offset, slot_bins, num_slots):
+    def __init__(self, feat_slot, feat_offset, slot_bins, num_slots,
+                 conflict_rate=0.0):
         self.feat_slot = np.asarray(feat_slot, dtype=np.int32)      # (F,)
         self.feat_offset = np.asarray(feat_offset, dtype=np.int32)  # (F,)
         self.slot_bins = np.asarray(slot_bins, dtype=np.int32)      # (S,)
         self.num_slots = int(num_slots)
+        self.conflict_rate = float(conflict_rate)
 
     @property
     def is_identity(self):
@@ -47,16 +52,18 @@ class BundlePlan:
     def to_dict(self):
         return {"feat_slot": self.feat_slot, "feat_offset": self.feat_offset,
                 "slot_bins": self.slot_bins,
-                "num_slots": np.asarray(self.num_slots)}
+                "num_slots": np.asarray(self.num_slots),
+                "conflict_rate": np.asarray(self.conflict_rate)}
 
     @classmethod
     def from_dict(cls, d):
         return cls(d["feat_slot"], d["feat_offset"], d["slot_bins"],
-                   int(d["num_slots"]))
+                   int(d["num_slots"]),
+                   float(d.get("conflict_rate", 0.0)))
 
 
-def plan_bundles(mappers, sample_bins, enable=True):
-    """Greedy conflict-free bundling on the binning sample.
+def plan_bundles(mappers, sample_bins, enable=True, max_conflict_rate=0.0):
+    """Greedy bundling on the binning sample.
 
     Args:
       mappers: per (used) feature BinMapper.
@@ -66,6 +73,13 @@ def plan_bundles(mappers, sample_bins, enable=True):
         (F, S_rows) stack (the planning analog of the reference never
         densifying sparse features, src/io/sparse_bin.hpp:17-331).
       enable: config is_enable_sparse.
+      max_conflict_rate: fraction of sample rows a bundle may hold in
+        conflict (conflicting cells keep the FIRST member's bin at
+        materialization). 0.0 keeps the exact greedy-EFB rule:
+        perfectly-exclusive features only. Near-exclusive wide data
+        (sparse text) needs a small tolerance to bundle at all — the
+        capacity the reference v0 gets from per-feature sparse bins
+        (sparse_bin.hpp) without any bundling.
 
     Returns a BundlePlan (identity when nothing bundles).
     """
@@ -99,11 +113,15 @@ def plan_bundles(mappers, sample_bins, enable=True):
     cnt = len(col_bins(order[0]))
     SIG = min(64, (cnt + 7) // 8)
     cap = MAX_SLOT_BINS - 1
+    budget = int(max_conflict_rate * cnt)
     max_b = len(order)
     sig_mat = np.zeros((max_b, SIG), np.uint8)
     used_arr = np.zeros(max_b, np.int64)
+    conf_arr = np.zeros(max_b, np.int64)   # conflicts accrued per bundle
     occ = []         # per-bundle packed occupancy, (cnt/8,) uint8
     members_l = []   # per-bundle member lists
+    popcount = np.unpackbits(np.arange(256, dtype=np.uint8)[:, None],
+                             axis=1).sum(axis=1).astype(np.int64)
     for j in order:
         col_nz = col_bins(j) > 0
         cp = np.packbits(col_nz)
@@ -111,12 +129,28 @@ def plan_bundles(mappers, sample_bins, enable=True):
         nb = mappers[j].num_bin
         b = len(occ)
         placed = -1
-        if b:
+        if b and budget == 0:
+            # exact mode: a signature hit IS a real conflict (the first
+            # SIG bytes are real rows) — boolean any() suffices and is
+            # the planning hot path every default-config run takes
             viable = ~((sig_mat[:b] & csig).any(axis=1)) \
                 & (used_arr[:b] + (nb - 1) <= cap)
             for idx in np.flatnonzero(viable):
                 if not (occ[idx] & cp).any():
                     placed = int(idx)
+                    break
+        elif b:
+            # tolerant mode: signature overlap popcount is an exact
+            # LOWER bound on the real overlap, so bundles it alone
+            # pushes past budget are rejected without the full check
+            sig_lb = popcount[sig_mat[:b] & csig].sum(axis=1)
+            viable = (conf_arr[:b] + sig_lb <= budget) \
+                & (used_arr[:b] + (nb - 1) <= cap)
+            for idx in np.flatnonzero(viable):
+                overlap = int(popcount[occ[idx] & cp].sum())
+                if conf_arr[idx] + overlap <= budget:
+                    placed = int(idx)
+                    conf_arr[idx] += overlap
                     break
         if placed >= 0:
             members_l[placed].append(j)
@@ -155,7 +189,8 @@ def plan_bundles(mappers, sample_bins, enable=True):
             slot_id += 1
     Log.info("Bundled %d sparse features into %d slots (%d stored rows "
              "for %d features)", len(bundled), len(bundles), slot_id, f)
-    return BundlePlan(feat_slot, feat_offset, slot_bins, slot_id)
+    return BundlePlan(feat_slot, feat_offset, slot_bins, slot_id,
+                      conflict_rate=max_conflict_rate)
 
 
 def build_stored_matrix(plan, bin_cols, dtype):

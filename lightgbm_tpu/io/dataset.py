@@ -378,9 +378,13 @@ class DatasetLoader:
                     ds = CoreDataset.load_binary(cand)
                 except Exception:
                     continue  # not a binary cache; fall through
-                if ds.bundle_plan is not None and not cfg.is_enable_sparse:
-                    # cache was built with bundling but this run
-                    # disabled it — rebuild from text (WITHOUT
+                if ds.bundle_plan is not None and (
+                        not cfg.is_enable_sparse
+                        or getattr(ds.bundle_plan, "conflict_rate", 0.0)
+                        > cfg.max_conflict_rate):
+                    # cache was built with bundling this run can't use
+                    # (disabled, or a MORE tolerant plan than this
+                    # config allows) — rebuild from text (WITHOUT
                     # overwriting the cache, so the original config
                     # keeps its bundling). (Feature-parallel handles
                     # bundled datasets since parallel/learners.py grew
@@ -558,7 +562,7 @@ class DatasetLoader:
                 mappers,
                 lambda u: mappers[u].value_to_bin(
                     sample_feat_col(real_idx[u])),
-                enable=True)
+                enable=True, max_conflict_rate=cfg.max_conflict_rate)
             if plan.is_identity:
                 plan = None
 
@@ -922,7 +926,7 @@ class DatasetLoader:
             plan = plan_bundles(
                 mappers,
                 lambda u: mappers[u].value_to_bin(sample_col(real_idx[u])),
-                enable=True)
+                enable=True, max_conflict_rate=cfg.max_conflict_rate)
             if plan.is_identity:
                 plan = None
 

@@ -184,6 +184,69 @@ def test_bundled_train_set_as_valid_set(sparse_data):
     np.testing.assert_allclose(valid_score, train_score, atol=1e-5)
 
 
+def test_conflict_tolerant_bundling():
+    """Near-exclusive one-hot groups (1% co-occurrence): the exact rule
+    (max_conflict_rate=0) cannot bundle them, a small tolerance can —
+    the capacity the reference v0 gets from per-feature sparse bins
+    (sparse_bin.hpp) without bundling at all. Conflicting cells keep
+    the first member's bin; everything else must decode identically to
+    the unbundled dataset."""
+    rng = np.random.RandomState(13)
+    n = 4000
+    cols = []
+    for g in range(4):
+        idx = rng.randint(0, 12, size=n)
+        onehot = np.zeros((n, 12), np.float32)
+        onehot[np.arange(n), idx] = 1.0
+        # ~1% of rows light a SECOND column in the same group
+        extra = rng.rand(n) < 0.01
+        onehot[extra, rng.randint(0, 12, size=extra.sum())] = 1.0
+        cols.append(onehot)
+    x = np.concatenate(cols, axis=1)
+    y = (x[:, 0] + x[:, 12] > 0.5).astype(np.float32)
+
+    def build(rate):
+        cfg = Config.from_params({
+            "objective": "binary", "verbose": -1,
+            "max_conflict_rate": rate})
+        return DatasetLoader(cfg).construct_from_matrix(x, label=y)
+
+    ds_exact = build(0.0)
+    ds_tol = build(0.05)
+    assert ds_tol.bundle_plan is not None
+    # colliding pairs fragment the exact plan; tolerance packs each
+    # group into ~one slot
+    exact_rows = ds_exact.bins.shape[0]
+    assert ds_tol.bins.shape[0] <= 12            # 48 cols -> ~a dozen
+    assert ds_tol.bins.shape[0] < exact_rows
+    # decode parity outside the tolerated conflict cells (reference
+    # dataset = unbundled construction)
+    cfg0 = Config.from_params({"objective": "binary", "verbose": -1,
+                               "is_enable_sparse": False})
+    ds_plain = DatasetLoader(cfg0).construct_from_matrix(x, label=y)
+    view = ds_tol.traversal_bins()
+    rows = np.arange(n)
+    diffs = 0
+    for f in range(48):
+        feats = np.full(n, f)
+        diffs += int((view[feats, rows] != ds_plain.bins[f, rows]).sum())
+    assert 0 < diffs <= int(0.05 * n) * ds_tol.bins.shape[0], diffs
+    # and it trains
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 7,
+                              "verbose": -1, "max_conflict_rate": 0.05,
+                              "num_iterations": 3, "metric_freq": 0})
+    ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    b = GBDT()
+    b.init(cfg, ds, obj, [])
+    for _ in range(3):
+        b.train_one_iter(is_eval=False)
+    assert b.models[0].num_leaves > 1
+
+
 def test_virtual_bins_view_matches_unbundled(sparse_data):
     x, y = sparse_data
     cfg = Config.from_params({"is_enable_sparse": True})
