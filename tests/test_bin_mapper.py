@@ -79,3 +79,40 @@ def test_nan_maps_like_zero():
     b_nan = m.value_to_bin(np.array([np.nan]))[0]
     b_zero = m.value_to_bin(np.array([0.0]))[0]
     assert b_nan == b_zero
+
+
+def test_device_binning_matches_host(monkeypatch):
+    """The accelerator binning pass (dataset.py _bin_dense_on_device)
+    must be BIT-identical to the host searchsorted rule, including f32
+    inputs adjacent to f64 bin boundaries (the f32 bound cast rounds
+    toward -inf, mirroring the device-predict threshold rule)."""
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+
+    rng = np.random.RandomState(3)
+    n, f = 5000, 6
+    x = rng.randn(n, f).astype(np.float32)
+    # adversarial column: values clustered so bounds are non-f32 f64
+    # midpoints, plus probes exactly at/next to those boundaries
+    base = (rng.randint(0, 50, n) / 10.0 + 0.05).astype(np.float32)
+    x[:, 0] = base
+    probe = np.float64(0.15)  # midpoint of 0.1/0.2-ish grids
+    x[:100, 0] = np.float32(probe)
+    x[100:200, 0] = np.nextafter(np.float32(probe), np.float32(2.0))
+    x[200:300, 0] = np.nextafter(np.float32(probe), np.float32(-2.0))
+    y = (x[:, 1] > 0).astype(np.float32)
+
+    def build():
+        cfg = Config.from_params({"objective": "binary", "verbose": -1,
+                                  "max_bin": 64})
+        return DatasetLoader(cfg).construct_from_matrix(x, label=y)
+
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BIN", "0")
+    host = build()
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BIN", "1")  # force on CPU
+    dev = build()
+    np.testing.assert_array_equal(host.bins, dev.bins)
+    for mh, md in zip(host.bin_mappers, dev.bin_mappers):
+        np.testing.assert_array_equal(mh.bin_upper_bound,
+                                      md.bin_upper_bound)
