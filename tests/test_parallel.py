@@ -21,7 +21,7 @@ def _train(cfg, X, y, rounds=10):
     ds = DatasetLoader(cfg).construct_from_matrix(X, label=y)
     obj = create_objective(cfg.objective, cfg)
     obj.init(ds.metadata, ds.num_data)
-    g = create_boosting("gbdt")
+    g = create_boosting(cfg.boosting_type)
     g.init(cfg, ds, obj, [])
     for _ in range(rounds):
         if g.train_one_iter(is_eval=False):
@@ -152,8 +152,10 @@ def test_data_parallel_partitioned_matches_serial_partitioned():
 
 
 def test_data_parallel_auto_keeps_masked():
-    """partitioned_build=auto must NOT switch the data-parallel learner
-    off the exact masked + Kahan path (the serial == DP guarantee)."""
+    """On NON-TPU backends partitioned_build=auto keeps the data-
+    parallel learner on the exact masked + Kahan path (on TPU, auto
+    now follows the serial rule and picks the partitioned core; the
+    exact guarantee there is partitioned_build=false)."""
     rng = np.random.RandomState(4)
     X = rng.rand(600, 5).astype(np.float32)
     y = (X[:, 0] > 0.5).astype(np.float32)
@@ -246,3 +248,35 @@ def test_voting_partitioned_same_vote_protocol():
     assert int(g1.models[0].split_feature_real[0]) == 0
     g3 = _train(cfg(3), x, y, rounds=1)
     assert int(g3.models[0].split_feature_real[0]) == 2
+
+
+@pytest.mark.parametrize("boosting", ["dart", "goss"])
+def test_boosting_variants_on_partitioned_data_parallel(boosting):
+    """DART and GOSS ride the same learner infrastructure; with the
+    leaf-contiguous builder now the TPU default for row-sharded
+    learners, their serial==data-parallel tree parity must hold on the
+    partitioned core too (same guarantee test_parallel pins for plain
+    GBDT)."""
+    rng = np.random.RandomState(5)
+    x = rng.rand(4000, 8).astype(np.float32)
+    y = (2 * x[:, 0] - x[:, 1] + 0.1 * rng.randn(4000) > 0.5) \
+        .astype(np.float32)
+    models = {}
+    for learner in ("serial", "data"):
+        cfg = Config.from_params({
+            "objective": "binary", "num_leaves": 15, "verbose": -1,
+            "boosting_type": boosting, "tree_learner": learner,
+            "num_machines": 1 if learner == "serial" else 4,
+            "partitioned_build": "true", "metric_freq": 0,
+            "min_data_in_leaf": 20, "drop_seed": 7})
+        if learner != "serial":
+            assert cfg.tree_learner == learner
+        b = _train(cfg, x, y, rounds=6)
+        assert b.tree_learner._use_partitioned
+        models[learner] = b
+    assert len(models["serial"].models) == len(models["data"].models)
+    for ts, td in zip(models["serial"].models, models["data"].models):
+        np.testing.assert_array_equal(ts.split_feature_real,
+                                      td.split_feature_real)
+        np.testing.assert_array_equal(ts.threshold_in_bin,
+                                      td.threshold_in_bin)
