@@ -136,6 +136,39 @@ def test_bundled_feature_parallel(sparse_data):
                                    rtol=1e-5, atol=1e-7)
 
 
+def test_bundled_feature_parallel_with_sampling(sparse_data):
+    """FP-bundled under feature_fraction + bagging: the per-shard
+    virtual fmask and the in-bag row mask must compose with the slot
+    expansion exactly as in the serial learner (same seeds -> same
+    samples -> identical trees)."""
+    x, y = sparse_data
+    trees = {}
+    for learner in ("serial", "feature"):
+        cfg = Config.from_params({
+            "objective": "binary", "num_leaves": 15, "verbose": -1,
+            "tree_learner": learner, "metric_freq": 0,
+            "num_machines": 1 if learner == "serial" else 4,
+            "feature_fraction": 0.7, "feature_fraction_seed": 3,
+            "bagging_fraction": 0.8, "bagging_freq": 1,
+            "min_data_in_leaf": 10, "is_enable_sparse": True,
+            "device_row_chunk": 512})
+        ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+        assert ds.bundle_plan is not None
+        obj = create_objective(cfg.objective, cfg)
+        obj.init(ds.metadata, ds.num_data)
+        b = GBDT()
+        b.init(cfg, ds, obj, [])
+        for _ in range(5):
+            b.train_one_iter(is_eval=False)
+        trees[learner] = b.models
+    assert len(trees["serial"]) == len(trees["feature"])
+    for t1, t2 in zip(trees["serial"], trees["feature"]):
+        np.testing.assert_array_equal(t1.split_feature_real,
+                                      t2.split_feature_real)
+        np.testing.assert_array_equal(t1.threshold_in_bin,
+                                      t2.threshold_in_bin)
+
+
 def test_bundled_feature_parallel_psum_fallback(sparse_data):
     """Same parity with the replicated stored copy disabled (the >1GB
     owner-broadcast psum path, threshold forced to 0)."""
