@@ -36,7 +36,7 @@ def sparse_data():
 
 
 def _train(x, y, enable_sparse, learner="serial", rounds=6,
-           partitioned="false"):
+           partitioned="false", extra_params=None):
     # num_machines > 1 is required for a parallel learner to survive
     # check_param_conflict (config.cpp:139-147 parity: one machine
     # means serial); 4 maps to 4 of the virtual CPU mesh devices
@@ -46,6 +46,7 @@ def _train(x, y, enable_sparse, learner="serial", rounds=6,
         "is_enable_sparse": enable_sparse, "tree_learner": learner,
         "device_row_chunk": 512, "partitioned_build": partitioned,
         "num_machines": 1 if learner == "serial" else 4,
+        **(extra_params or {}),
     })
     if learner != "serial":
         assert cfg.tree_learner == learner
@@ -142,24 +143,13 @@ def test_bundled_feature_parallel_with_sampling(sparse_data):
     expansion exactly as in the serial learner (same seeds -> same
     samples -> identical trees)."""
     x, y = sparse_data
+    sampling = {"feature_fraction": 0.7, "feature_fraction_seed": 3,
+                "bagging_fraction": 0.8, "bagging_freq": 1}
     trees = {}
     for learner in ("serial", "feature"):
-        cfg = Config.from_params({
-            "objective": "binary", "num_leaves": 15, "verbose": -1,
-            "tree_learner": learner, "metric_freq": 0,
-            "num_machines": 1 if learner == "serial" else 4,
-            "feature_fraction": 0.7, "feature_fraction_seed": 3,
-            "bagging_fraction": 0.8, "bagging_freq": 1,
-            "min_data_in_leaf": 10, "is_enable_sparse": True,
-            "device_row_chunk": 512})
-        ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+        b, ds = _train(x, y, enable_sparse=True, learner=learner,
+                       rounds=5, extra_params=sampling)
         assert ds.bundle_plan is not None
-        obj = create_objective(cfg.objective, cfg)
-        obj.init(ds.metadata, ds.num_data)
-        b = GBDT()
-        b.init(cfg, ds, obj, [])
-        for _ in range(5):
-            b.train_one_iter(is_eval=False)
         trees[learner] = b.models
     assert len(trees["serial"]) == len(trees["feature"])
     for t1, t2 in zip(trees["serial"], trees["feature"]):
