@@ -1,0 +1,66 @@
+"""bench.py's measurement-integrity helpers.
+
+The TPU tunnel memoizes whole dispatches (program + inputs) across
+sessions (BASELINE.md round 5), so the bench's defenses — unique
+inputs per process and memo-suspect flags — are load-bearing for the
+driver's end-of-round numbers.
+"""
+
+import importlib.util
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", "/root/repo/bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_under_test"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_make_data_busts_memoization(bench, monkeypatch):
+    """Two processes' datasets must differ (the tunnel memo key is the
+    input bytes); with the bust disabled they must be identical (the
+    AUC-pinned canonical data)."""
+    monkeypatch.delenv("BENCH_NO_MEMO_BUST", raising=False)
+    x1, y1 = bench.make_data(10_000)
+    x2, y2 = bench.make_data(10_000)
+    np.testing.assert_array_equal(x1, x2)      # features stay canonical
+    assert (y1 != y2).sum() > 0                # labels differ per call
+    assert (y1 != y2).sum() <= 16              # ...by at most 2*8 flips
+    monkeypatch.setenv("BENCH_NO_MEMO_BUST", "1")
+    x3, y3 = bench.make_data(10_000)
+    x4, y4 = bench.make_data(10_000)
+    np.testing.assert_array_equal(y3, y4)      # pinned mode is exact
+
+
+def test_format_result_propagates_memo_flags(bench):
+    res = {"time_s": 5.0, "auc": 0.93, "n_rows": 1_000_000,
+           "n_iters": 100, "path": "tpu-part", "platform": "tpu",
+           "load_s": 1.0, "phases": {"compile": 30.0},
+           "memo_suspect": True, "predict_memo_suspect": True}
+    out = bench._format_result(res, "probe ok")
+    assert out["memo_suspect"] is True
+    assert out["predict_memo_suspect"] is True
+    assert out["phases"] == {"compile": 30.0}
+    assert out["vs_baseline"] > 0
+
+
+def test_ref_time_anchors(bench):
+    """The measured per-row-count anchors must be used verbatim at
+    their measured iteration counts and scale linearly in iterations."""
+    t, measured = bench._ref_time(1_000_000, 100)
+    assert measured and abs(t - bench.REF_TRAIN_SECONDS) < 1e-9
+    t10, m10 = bench._ref_time(100_000, 10)
+    assert m10 and abs(t10 - 0.29 * bench.REF_TRAIN_SECONDS / 22.2) < 1e-9
+    t11, m11 = bench._ref_time(11_000_000, 100)
+    assert m11 and abs(t11 - 411.2 * bench.REF_TRAIN_SECONDS / 22.2) < 1e-9
+    # unmeasured shape: linear row/iter scaling of the canonical anchor
+    t_other, m_other = bench._ref_time(500_000, 50)
+    assert not m_other
+    assert abs(t_other - bench.REF_TRAIN_SECONDS * 0.5 * 0.5) < 1e-9
