@@ -159,6 +159,35 @@ def test_csc_wide_sparse_is_onnz(monkeypatch):
     assert ds.num_features == f
 
 
+def test_categorical_through_sparse_route(tmp_path):
+    """A categorical column in a LibSVM file binned by the triplet
+    route: category id 0 rides the zero-bin PREFILL (categorical
+    features never bundle, so their slot default is value_to_bin(0)),
+    nonzero ids bin through the category lookup — bins must equal the
+    in-memory dense construction exactly."""
+    rng = np.random.RandomState(21)
+    n = 2000
+    cat = rng.choice([0, 3, 7, 12], size=n).astype(np.float64)
+    oh = np.zeros((n, 20))
+    oh[np.arange(n), rng.randint(0, 20, n)] = 1.0
+    x = np.concatenate([cat[:, None], oh], axis=1)
+    y = (cat > 5).astype(np.float64)
+    path = tmp_path / "cat.libsvm"
+    _write_libsvm(path, x, y)
+    built = {}
+    for tworound in (False, True):
+        cfg = Config.from_params({
+            "categorical_column": "0", "verbose": -1,
+            "use_two_round_loading": tworound,
+            "enable_load_from_binary_file": False})
+        built[tworound] = DatasetLoader(cfg).load_from_file(str(path))
+    assert built[True].bin_mappers[0].bin_type == 1
+    np.testing.assert_array_equal(built[False].bins, built[True].bins)
+    np.testing.assert_array_equal(
+        np.asarray(built[False].metadata.label),
+        np.asarray(built[True].metadata.label))
+
+
 def test_budget_guard_fires(monkeypatch):
     """Unbundleable wide data over budget must fail LOUDLY, naming the
     bundling knob — not OOM."""
