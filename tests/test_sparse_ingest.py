@@ -226,6 +226,38 @@ def test_aligned_libsvm_valid_file_streams_sparse(wide_data, tmp_path):
     np.testing.assert_array_equal(d_va.bins, d_va_mem.bins)
 
 
+def test_multiclass_through_sparse_route(tmp_path):
+    """Multiclass training over a sparse-streamed LibSVM file: labels
+    parse through the triplet route, class-major trees train on the
+    bundled slot matrix."""
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    rng = np.random.RandomState(41)
+    n = 2400
+    oh = np.zeros((n, 24))
+    oh[np.arange(n), rng.randint(0, 24, n)] = 1.0
+    y = (np.argmax(oh[:, :3], axis=1)
+         + (oh[:, :3].sum(1) == 0) * 2).astype(np.float64)
+    path = tmp_path / "mc.libsvm"
+    _write_libsvm(path, oh, y)
+    cfg = Config.from_params({
+        "objective": "multiclass", "num_class": 3, "verbose": -1,
+        "num_leaves": 7, "metric_freq": 0, "min_data_in_leaf": 10,
+        "use_two_round_loading": True,
+        "enable_load_from_binary_file": False})
+    ds = DatasetLoader(cfg).load_from_file(str(path))
+    assert ds.bundle_plan is not None
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    b = GBDT()
+    b.init(cfg, ds, obj, [])
+    for _ in range(4):
+        b.train_one_iter(is_eval=False)
+    assert len(b.models) == 12             # 4 iters x 3 classes
+    pred = b.predict(oh.astype(np.float32))
+    assert (np.argmax(pred, 1) == y).mean() > 0.9
+
+
 def test_valid_set_shares_bundle_plan(wide_data):
     """A valid set built against a bundled train set stores the same
     O(slots x N) matrix (not the dense virtual matrix) and scores
