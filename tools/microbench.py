@@ -53,6 +53,9 @@ def _peak_gbs():
     return PEAK_HBM_GBS.get(gen, 819.0), gen
 
 
+RESULTS = {}   # label -> {ms[, gbs, pct_peak_hbm]}; dumped at end of main
+
+
 def chain_time(fn, make_init, k, label, step_bytes=None):
     """Median wall-clock of a k-step dependent scan chain / k, with a
     DISTINCT init per timed call (see module docstring). Prints achieved
@@ -76,10 +79,16 @@ def chain_time(fn, make_init, k, label, step_bytes=None):
         times.append((time.perf_counter() - t0) / k)
     ms = sorted(times)[1] * 1e3
     util = ""
+    rec = {"ms": round(ms, 3)}
     if step_bytes:
         gbs = step_bytes / (ms * 1e-3) / 1e9
         peak, gen = _peak_gbs()
         util = f"{gbs:9.1f} GB/s  {100.0 * gbs / peak:5.1f}% of {gen} HBM"
+        rec["gbs"] = round(gbs, 1)
+        rec["pct_peak_hbm"] = round(100.0 * gbs / peak, 1)
+    if label in RESULTS:          # clamped segment sizes can repeat
+        label = f"{label} (dup)"
+    RESULTS[label] = rec
     print(f"{label:34s} {ms:8.3f} ms {util}", flush=True)
     return ms
 
@@ -244,8 +253,15 @@ def main():
         np.asarray(b.get_training_score())
         dt = (time.time() - t0) / k
         name = "partitioned" if part == "true" else "masked"
+        RESULTS[f"fused_iter_{name}"] = {"ms": round(dt * 1e3, 2)}
         print(f"fused_iter {name} {n_real}x28x63l: {dt * 1e3:9.2f} ms/iter",
               flush=True)
+
+    # machine-readable summary (one line, BASELINE-quotable)
+    import json
+    print("MICROBENCH_JSON " + json.dumps(
+        {"backend": jax.default_backend(), "n": n, "k": k,
+         "results": RESULTS}), flush=True)
 
 
 if __name__ == "__main__":
