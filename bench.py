@@ -22,10 +22,10 @@ probe because the axon plugin retries a dead relay forever):
   timeout; EVERY measurement runs in a subprocess with its own timeout,
   with a fallback ladder: TPU partitioned builder -> TPU masked builder
   (BENCH_NO_PARTITIONED=1) -> TPU XLA path
-  (LIGHTGBM_TPU_DISABLE_PALLAS=1) -> CPU at a REDUCED workload
-  (default 100k rows x 10 iters, ~90s measured on this image's CPU)
-  so the last rung provably terminates inside its budget; its result
-  line names the actual workload and carries the scaling factors;
+  (LIGHTGBM_TPU_DISABLE_PALLAS=1, gather-compacted engine) -> CPU at a
+  REDUCED workload (default 100k rows x 10 iters, gather-compacted
+  engine) so the last rung provably terminates inside its budget; its
+  result line names the actual workload and carries the scaling factors;
 - a global deadline (BENCH_GLOBAL_DEADLINE, default 1500s) shrinks
   each rung's timeout so the ladder as a whole cannot outlive the
   driver's patience; the CPU rung's budget is always reserved;
@@ -37,6 +37,10 @@ Output: each printed line is a complete result JSON
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 vs_baseline > 1 means faster than the reference. Parsers taking the
 LAST JSON line get the richest result; the FIRST is already complete.
+The `phases` dict carries the host-timed compile phase, per-op
+microprobe timings (`hist`/`split`/`score_update`, seconds per call —
+see phase_probe) and `compile_cache_hit` (1.0 when the persistent
+compile cache served the fused program's lowering).
 """
 
 import json
@@ -59,8 +63,9 @@ TPU_PROBE_TIMEOUT_S = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "150"))
 PRIMARY_TIMEOUT_S = int(os.environ.get("BENCH_PRIMARY_TIMEOUT", "900"))
 HIGGS_TIMEOUT_S = int(os.environ.get("BENCH_HIGGS_TIMEOUT", "1200"))
 GLOBAL_DEADLINE_S = int(os.environ.get("BENCH_GLOBAL_DEADLINE", "1500"))
-# Reduced CPU-rung workload: measured ~90s on this image (JAX CPU,
-# partitioned builder, 100k x 28 x 10 iters) — terminates with margin.
+# Reduced CPU-rung workload: measured ~13s train + ~2s cold compile on
+# this image (JAX CPU, gather-compacted engine + segment-sum chunk
+# kernel, 100k x 28 x 10 iters) — terminates with wide margin.
 CPU_ROWS = int(os.environ.get("BENCH_CPU_ROWS", 100_000))
 CPU_ITERS = int(os.environ.get("BENCH_CPU_ITERS", 10))
 CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "420"))
@@ -243,7 +248,7 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.objectives import create_objective
 
-    cfg = Config.from_params({
+    params = {
         "objective": "binary",
         "num_leaves": 63,
         "max_bin": 255,
@@ -251,13 +256,19 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
         "num_iterations": n_iters,
         "metric": "auc",
         "metric_freq": 0,  # no eval inside the timed loop
-        # leaf-contiguous builder on every backend (auto = TPU only):
-        # histogram cost scales with leaf size, ~20x less streaming at
-        # 63 leaves (models/partitioned.py); BENCH_NO_PARTITIONED is the
-        # fallback-ladder escape hatch
+        # engine selection mirrors the shipped defaults: "auto" runs the
+        # leaf-contiguous builder on TPU and the gather-compacted dense
+        # builder elsewhere (docs/Histogram-Engine.md);
+        # BENCH_NO_PARTITIONED is the fallback-ladder escape hatch
         "partitioned_build": ("false" if os.environ.get("BENCH_NO_PARTITIONED")
-                              else "true"),
-    })
+                              else "auto"),
+    }
+    if os.environ.get("LIGHTGBM_TPU_DISABLE_PALLAS"):
+        # the tpu-xla rung loses the pallas streaming kernel; force the
+        # compacted engine (auto keeps it off on TPU in deference to
+        # that kernel) so the XLA fallback is row-proportional too
+        params["hist_compaction"] = "true"
+    cfg = Config.from_params(params)
 
     _mark(f"generating {n_rows} rows")
     x, y = make_data(n_rows)
@@ -310,7 +321,90 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     auc_metric = create_metric("auc", cfg)
     auc_metric.init(ds.metadata, ds.num_data)
     auc = float(auc_metric.eval(booster.get_training_score())[0])
-    return train_s, auc, booster, load_s, TIMERS.snapshot(), x
+    phases = TIMERS.snapshot()
+    _mark("probing per-op phase timings")
+    phases.update({k: round(v, 6) for k, v in phase_probe(booster).items()})
+    # 1.0 = the fused program's lowering was served by the persistent
+    # compile cache (config.py setup_compilation_cache)
+    phases["compile_cache_hit"] = float(booster.last_compile_cache_hit)
+    return train_s, auc, booster, load_s, phases, x
+
+
+def phase_probe(booster):
+    """Per-op microprobe timings for the result's `phases` dict: `hist`
+    (one histogram build on the ACTIVE engine — full segment range when
+    partitioned, a half-array leaf when compacted, a root scan when
+    masked), `split` (one best-split scan), and `score_update` (one
+    partition-gather score update), each in seconds per call (median of
+    3 after a warm-up). The timed loop runs ONE
+    fused XLA program whose internal phases host timers cannot see, so
+    these single-op measurements are how BENCH_r* JSON tracks where
+    device time goes as the histogram engine evolves."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.split import find_best_split
+
+    learner = booster.tree_learner
+    n_pad, f_pad, b = learner.n_pad, learner.f_pad, learner.max_bin
+    ghc_t = jnp.ones((3, n_pad), dtype=jnp.float32)
+
+    if getattr(learner, "_use_partitioned", False):
+        from lightgbm_tpu.ops.ordered_hist import segment_histograms
+        s_pad = 4 * learner._bins.shape[0]
+
+        def hist_fn():
+            return segment_histograms(learner._bins, ghc_t, jnp.int32(0),
+                                      jnp.int32(n_pad), b, s_pad)
+    elif getattr(learner, "_use_compact", False):
+        # probe the ACTIVE engine at a representative child size: a
+        # half-array leaf (the first split's smaller child upper bound)
+        from lightgbm_tpu.ops.histogram import compacted_histograms
+        half_leaf = (jnp.arange(n_pad, dtype=jnp.int32) % 2)
+
+        def hist_fn():
+            hi, lo = compacted_histograms(learner._bins, ghc_t, half_leaf,
+                                          jnp.int32(0), b,
+                                          learner.row_chunk)
+            return hi + lo
+    else:
+        from lightgbm_tpu.ops.pallas_hist import masked_histograms
+
+        def hist_fn():
+            hi, lo = masked_histograms(learner._bins, ghc_t,
+                                       jnp.zeros(n_pad, jnp.int32),
+                                       jnp.int32(0), b, learner.row_chunk)
+            return hi + lo
+
+    hist3 = jnp.ones((f_pad, b, 3), dtype=jnp.float32)
+    fmask = jnp.ones(f_pad, dtype=bool)
+
+    def split_fn():
+        return find_best_split(hist3, jnp.float32(0.0), jnp.float32(n_pad),
+                               jnp.float32(n_pad), learner._num_bin_pf,
+                               learner._is_cat, fmask, learner.params)
+
+    leaf_vals = jnp.ones(63, dtype=jnp.float32)
+    row_leaf = jnp.zeros(n_pad, dtype=jnp.int32)
+    score = jnp.zeros(n_pad, dtype=jnp.float32)
+
+    def score_fn():
+        return score + jnp.take(leaf_vals, row_leaf)
+
+    out = {}
+    for name, fn in (("hist", hist_fn), ("split", split_fn),
+                     ("score_update", score_fn)):
+        try:
+            jit_fn = jax.jit(fn)
+            jax.block_until_ready(jit_fn())  # compile + warm
+            times = []
+            for _ in range(3):
+                t0 = time.time()
+                jax.block_until_ready(jit_fn())
+                times.append(time.time() - t0)
+            out[name] = sorted(times)[1]
+        except Exception as e:  # a probe must never cost the result
+            _mark(f"phase probe {name} failed: {e}")
+    return out
 
 
 def run_child():
@@ -334,9 +428,15 @@ def run_child():
         jax.config.update("jax_platforms", "cpu")
     # persistent compilation cache: a prior run's compiled programs
     # (same shapes/config) skip the 10-60s XLA compile — precious when
-    # the tunnel's live windows are short
-    jax.config.update("jax_compilation_cache_dir", os.path.join(
+    # the tunnel's live windows are short. Activated HERE, before the
+    # first compile, so pre-training work (device binning, data prep)
+    # caches too; the library's own setup (config.py
+    # setup_compilation_cache, invoked at learner init) then sees the
+    # dir already configured and leaves it in place.
+    cache_dir = os.environ.setdefault("LIGHTGBM_TPU_CACHE_DIR", os.path.join(
         os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     n_rows = int(os.environ["BENCH_CHILD_ROWS"])
     n_iters = int(os.environ.get("BENCH_CHILD_ITERS", NUM_ITERATIONS))
     train_s, auc, booster, load_s, phases, x_raw = train_once(n_rows, n_iters)

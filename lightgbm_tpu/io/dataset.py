@@ -486,10 +486,16 @@ class DatasetLoader:
         # (N, F) float block the O(nnz) route exists to avoid (the
         # reference gets this from per-feature sparse bins,
         # sparse_bin.hpp; here the format sniff stands in for its
-        # sparse_rate auto-selection, bin.cpp:291-302).
+        # sparse_rate auto-selection, bin.cpp:291-302). The auto-route
+        # carries the SAME weight/group guard as _load_two_round's
+        # sparse_route: with those columns set the streamer falls back
+        # to dense (65536, num_cols) parse blocks — multi-GB at the
+        # widths that trigger the probe — so such configs keep the
+        # in-memory path unless the user explicitly asked to stream.
         if self.predict_fun is None and (
                 cfg.use_two_round_loading
-                or _libsvm_looks_wide(filename, cfg.has_header)):
+                or (cfg.weight_column == "" and cfg.group_column == ""
+                    and _libsvm_looks_wide(filename, cfg.has_header))):
             ds = self._load_two_round(filename, rank, num_machines)
             if ds.global_num_data is not None:
                 if cfg.is_save_binary_file:

@@ -20,15 +20,22 @@ NA_VALUES = ["na", "NA", "nan", "NaN", "null"]
 
 
 def libsvm_pairs(tokens):
-    """Parse `idx:val` tokens, skipping malformed ones (empty index or
-    missing colon) — shared by the in-memory and streaming loaders so
-    both paths treat the same line identically."""
+    """Parse `idx:val` tokens, skipping malformed ones (empty or
+    non-numeric index — e.g. ranking-style `qid:3` — or an unparsable
+    value) — shared by the in-memory and streaming loaders so both
+    paths treat the same line identically."""
     out = []
     for tok in tokens:
         c = tok.find(":")
         if c <= 0:
             continue
-        out.append((int(tok[:c]), float(tok[c + 1:])))
+        try:
+            idx, val = int(tok[:c]), float(tok[c + 1:])
+        except ValueError:
+            continue  # skip, matching the documented rule
+        if idx < 0:
+            continue  # a negative index would write the label column
+        out.append((idx, val))
     return out
 
 

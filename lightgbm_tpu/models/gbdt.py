@@ -280,6 +280,8 @@ class GBDT:
         self.best_msg = []
         self._bag_rows = None       # in-bag float mask or None
         self._bag_window = None     # it // bagging_freq of the cached bag
+        self.last_compile_cache_hit = False  # persistent-cache hit on
+        #                             the latest fused-program lowering
 
     # ------------------------------------------------------------------ init
     def init(self, config, train_data, objective, training_metrics=()):
@@ -530,67 +532,105 @@ class GBDT:
         n, n_pad = learner.num_data, learner.n_pad
         pad = n_pad - n
         core = learner._build_core
-        grad_fn = self.objective._grad
-        bins = learner._bins
-        nbpf = learner._num_bin_pf
-        iscat = learner._is_cat
         shrink = jnp.float32(self.shrinkage_rate)
-        inbag = jnp.concatenate([jnp.ones(n, jnp.float32),
-                                 jnp.zeros(pad, jnp.float32)])
+        # every data-dependent array rides as a runtime ARGUMENT of the
+        # compiled program, not a closure: closed-over arrays embed
+        # their VALUES in the lowered HLO, so two runs with (say)
+        # different labels would hash to different persistent-cache
+        # entries and recompile. With the operands as arguments the
+        # program bytes depend only on shapes/dtypes — one lowered
+        # executable per (shape bucket, config) per machine.
+        grad_pure = getattr(self.objective, "_grad_pure", None)
+        data = {
+            "bins": learner._bins,
+            "nbpf": learner._num_bin_pf,
+            "iscat": learner._is_cat,
+            "inbag": jnp.concatenate([jnp.ones(n, jnp.float32),
+                                      jnp.zeros(pad, jnp.float32)]),
+        }
+        if grad_pure is not None:
+            data["gops"] = self.objective._grad_ops
+        else:
+            grad_fn = self.objective._grad  # closure fallback
 
         num_class = self.num_class
-        use_partitioned = getattr(learner, "_use_partitioned", False)
+        # both the partitioned and the gather-compacted builders dispatch
+        # histogram work through a bucketed lax.switch: vmapping them
+        # over the class axis would execute EVERY bucket branch per
+        # split, so those cores scan classes instead
+        use_switch_core = (getattr(learner, "_use_partitioned", False)
+                           or getattr(learner, "_use_compact", False))
         inbag_fn = self._fused_inbag_fn()
 
-        def step(score, xs):
-            fmask, it = xs  # fmask: (K, F) — one mask PER CLASS TREE,
-            # matching the sequential path's per-tree feature sampling
-            # (serial_tree_learner.cpp:160-165 samples per Train call)
-            g, h = grad_fn(score)
-            gp = jnp.pad(g, ((0, 0), (0, pad)))
-            hp = jnp.pad(h, ((0, 0), (0, pad)))
-            # per-iteration in-bag weights (GOSS); pad rows stay zero
-            ib = inbag if inbag_fn is None else inbag_fn(it, gp, hp) * inbag
-            if num_class == 1:
-                out = core(bins, gp[0], hp[0], ib, fmask[0], nbpf, iscat)
-                upd = jnp.take(out["leaf_value"], out["row_leaf"][:n])[None, :]
-            elif not use_partitioned:
-                # one device program for ALL classes: vmap the whole-tree
-                # builder over the class axis (SURVEY M2; the reference
-                # loops classes serially, gbdt.cpp:210-245)
-                out = jax.vmap(
-                    lambda gg, hh, fm: core(bins, gg, hh, ib, fm,
-                                            nbpf, iscat))(gp, hp, fmask)
-                upd = jax.vmap(
-                    lambda lv, rl: jnp.take(lv, rl[:n]))(
-                        out["leaf_value"], out["row_leaf"])
-            else:
-                # partitioned builder: scan the class axis instead of
-                # vmap — vmapping its bucketed lax.switch would execute
-                # EVERY bucket branch per split; scan keeps one branch
-                # per class (still a single compiled program, matching
-                # the reference's sequential class loop)
-                def class_step(_, gh):
-                    gg, hh, fm = gh
-                    o = core(bins, gg, hh, ib, fm, nbpf, iscat)
-                    u = jnp.take(o["leaf_value"], o["row_leaf"][:n])
-                    return None, (o, u)
+        def fused(score, fmasks, iters, d):
+            bins, nbpf, iscat, inbag = (d["bins"], d["nbpf"], d["iscat"],
+                                        d["inbag"])
 
-                _, (out, upd) = jax.lax.scan(class_step, None,
-                                             (gp, hp, fmask))
-            score = score + upd * shrink
-            del out["row_leaf"]  # keep the stacked ys O(iter * num_leaves)
-            return score, out
+            def step(score, xs):
+                fmask, it = xs  # fmask: (K, F) — one mask PER CLASS
+                # TREE, matching the sequential path's per-tree feature
+                # sampling (serial_tree_learner.cpp:160-165)
+                if grad_pure is not None:
+                    g, h = grad_pure(d["gops"], score)
+                else:
+                    g, h = grad_fn(score)
+                gp = jnp.pad(g, ((0, 0), (0, pad)))
+                hp = jnp.pad(h, ((0, 0), (0, pad)))
+                # per-iteration in-bag weights (GOSS); pad rows stay zero
+                ib = (inbag if inbag_fn is None
+                      else inbag_fn(it, gp, hp) * inbag)
+                if num_class == 1:
+                    out = core(bins, gp[0], hp[0], ib, fmask[0], nbpf,
+                               iscat)
+                    upd = jnp.take(out["leaf_value"],
+                                   out["row_leaf"][:n])[None, :]
+                elif not use_switch_core:
+                    # one device program for ALL classes: vmap the
+                    # whole-tree builder over the class axis (SURVEY M2;
+                    # the reference loops classes serially,
+                    # gbdt.cpp:210-245)
+                    out = jax.vmap(
+                        lambda gg, hh, fm: core(bins, gg, hh, ib, fm,
+                                                nbpf, iscat))(gp, hp, fmask)
+                    upd = jax.vmap(
+                        lambda lv, rl: jnp.take(lv, rl[:n]))(
+                            out["leaf_value"], out["row_leaf"])
+                else:
+                    # partitioned/compacted builder: scan the class axis
+                    # instead of vmap — vmapping the bucketed lax.switch
+                    # would execute EVERY bucket branch per split; scan
+                    # keeps one branch per class (still a single
+                    # compiled program, matching the reference's
+                    # sequential class loop)
+                    def class_step(_, gh):
+                        gg, hh, fm = gh
+                        o = core(bins, gg, hh, ib, fm, nbpf, iscat)
+                        u = jnp.take(o["leaf_value"], o["row_leaf"][:n])
+                        return None, (o, u)
 
-        def fused(score, fmasks, iters):
+                    _, (out, upd) = jax.lax.scan(class_step, None,
+                                                 (gp, hp, fmask))
+                score = score + upd * shrink
+                del out["row_leaf"]  # keep the ys O(iter * num_leaves)
+                return score, out
+
             return jax.lax.scan(step, score, (fmasks, iters))
 
         score = self.train_score_updater.score
         fmasks = jnp.ones((num_iters, num_class, learner.f_pad), dtype=bool)
         iters = jnp.arange(num_iters, dtype=jnp.int32)
-        compiled = jax.jit(fused).lower(score, fmasks, iters).compile()
-        self._fused_cache[key] = compiled
-        return compiled
+        from ..config import compile_cache_hits
+        hits_before = compile_cache_hits()
+        compiled = jax.jit(fused).lower(score, fmasks, iters, data).compile()
+        # whether the persistent compile cache served this lowering —
+        # surfaced by bench.py as phases.compile_cache_hit
+        self.last_compile_cache_hit = compile_cache_hits() > hits_before
+
+        def runner(score, fmasks, iters):
+            return compiled(score, fmasks, iters, data)
+
+        self._fused_cache[key] = runner
+        return runner
 
     def warm_up_fused(self, num_iters):
         """Pre-compile the fused trainer (compile time is not training

@@ -8,10 +8,16 @@ gradient gathers of SerialTreeLearner::BeforeFindBestSplit
 (serial_tree_learner.cpp:236-337) — the reference's machinery for
 making per-leaf histogram cost proportional to rows-in-leaf.
 
-The masked builder (tree_learner.py build_tree_device) streams ALL N
-rows for every split: exact but O(N) per split — at 63 leaves ~96% of
-that streaming is rows of other leaves (BASELINE.md "Known bound").
-This builder keeps the bin matrix PHYSICALLY sorted by leaf:
+This is the heaviest of the three histogram engines (see
+docs/Histogram-Engine.md): the masked builder streams ALL N rows per
+split (O(N), exact), the gather-compacted builder (the dense default,
+ops/histogram.py compacted_histograms) gathers the child's rows into a
+bucket-padded buffer (O(child rows), no layout change), and this
+builder goes one further by keeping the bin matrix PHYSICALLY sorted
+by leaf — no per-split O(N) mask/rank pass at all, at the cost of
+moving the packed words on every split. All three share the same
+per-chunk histogram kernel (ops/histogram.py _hist_chunk: one-hot MXU
+contraction on TPU, segment-sum scatter-add on CPU):
 
 - rows live in packed words (4 features/int32, ops/ordered_hist.py);
   a leaf is a position range [seg_begin[leaf], +seg_cnt[leaf]);

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.histogram import compacted_histograms
+from ..ops.ordered_hist import canonical_row_chunks
 from ..ops.pallas_hist import masked_histograms, HIST_CHUNK
 from ..ops.split import SplitParams, find_best_split, K_MIN_SCORE
 from ..utils.random import Random
@@ -51,17 +53,32 @@ def _identity(x):
     return x
 
 
-def _partitioned_mode(cfg):
-    """Validate + normalize partitioned_build to "auto"/"true"/"false"."""
-    mode = str(getattr(cfg, "partitioned_build", "auto")).lower()
+def _tristate(value, name):
+    """Normalize a config tri-state to "auto"/"true"/"false"."""
+    mode = str(value).lower()
     if mode in ("true", "1", "on", "+"):
         return "true"
     if mode in ("false", "0", "off", "-"):
         return "false"
     if mode != "auto":
-        Log.fatal('partitioned_build must be "auto", "true" or '
-                  '"false", got [%s]', mode)
+        Log.fatal('%s must be "auto", "true" or "false", got [%s]',
+                  name, mode)
     return "auto"
+
+
+def _partitioned_mode(cfg):
+    """Validate + normalize partitioned_build to "auto"/"true"/"false"."""
+    return _tristate(getattr(cfg, "partitioned_build", "auto"),
+                     "partitioned_build")
+
+
+def pow2_scan_chunk(chunk):
+    """Largest power-of-two scan chunk <= `chunk`, capped at HIST_CHUNK —
+    the only values guaranteed to divide HIST_CHUNK-padded row counts.
+    Shared by the serial and meshed learners' _effective_chunk."""
+    if chunk >= HIST_CHUNK:
+        return HIST_CHUNK
+    return 1 << (max(int(chunk), 1).bit_length() - 1)
 
 
 def init_split_state(l, root_split, root_c):
@@ -169,7 +186,8 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
                       max_depth, row_chunk,
                       hist_psum_fn=_collapse_pair, sum_psum_fn=_identity,
                       evaluate_fn=None, split_col_fn=None,
-                      expand_fn=_identity, cache_hists=True):
+                      expand_fn=_identity, cache_hists=True,
+                      compact_hist=False):
     """Grow one leaf-wise tree on device. All shapes static.
 
     Args:
@@ -210,6 +228,14 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
         (histogram_pool_size exceeded, feature_histogram.hpp:337-481's
         LRU analog): both children's histograms are recomputed at each
         split, memory O(F * B) instead of O(L * F * B).
+      compact_hist: per-split child histograms gather the leaf's rows
+        into a bucket-padded contiguous buffer first (ops/histogram.py
+        compacted_histograms) — cost O(rows-in-child) instead of the
+        full-scan's O(N); N_pad must then be a multiple of HIST_CHUNK.
+        The root histogram stays a full streaming scan (its bucket IS
+        the whole array). Works under every collective hook: the pair
+        contract is unchanged and the bucketed lax.switch holds no
+        collectives, so hist_psum_fn still meets shards in lockstep.
 
     Returns a dict of tree arrays + the final row->leaf partition.
     """
@@ -235,16 +261,25 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
     # packed per-row stats, stats-major for the masked histogram kernel
     ghc_t = jnp.stack([g_in, h_in, inbag], axis=0)  # (3, N_pad)
 
-    def leaf_histogram(row_leaf, leaf_id):
+    def full_scan_histogram(row_leaf, leaf_id):
         """Full-bandwidth streaming pass selecting `leaf_id`'s rows by
         mask (ops/pallas_hist.py) — the TPU replacement for the
         reference's ordered-gather ConstructHistogram."""
         return masked_histograms(bins, ghc_t, row_leaf, leaf_id, b,
                                  row_chunk)
 
+    if compact_hist:
+        def leaf_histogram(row_leaf, leaf_id):
+            """Gather-compacted smaller-child pass: stream only the
+            geometric chunk bucket covering the leaf's rows."""
+            return compacted_histograms(bins, ghc_t, row_leaf, leaf_id,
+                                        b, row_chunk)
+    else:
+        leaf_histogram = full_scan_histogram
+
     # ---- root ----------------------------------------------------------
     row_leaf0 = jnp.zeros(n_pad, dtype=jnp.int32)
-    hist_root = hist_psum_fn(leaf_histogram(row_leaf0, jnp.int32(0)))
+    hist_root = hist_psum_fn(full_scan_histogram(row_leaf0, jnp.int32(0)))
     # root sums from the reduced histogram: feature 0's bins partition
     # the rows, so its bin sums ARE the leaf totals — this keeps parent
     # sums bit-consistent with the histogram across serial/parallel
@@ -351,6 +386,11 @@ class SerialTreeLearner:
         self.config = config
         self.random = Random(config.feature_fraction_seed)
         self.train_set = None
+        # persistent compile cache: the jitted builders are the
+        # process's big XLA programs — make their compile a
+        # once-per-machine cost (config.py setup_compilation_cache)
+        from ..config import setup_compilation_cache
+        setup_compilation_cache(config)
 
     def init(self, train_set):
         self.train_set = train_set
@@ -362,6 +402,10 @@ class SerialTreeLearner:
         self.max_bin = int(train_set.max_stored_bin)
         self._bundle = train_set.bundle_plan
         self._use_partitioned = self._partitioned_enabled(cfg)
+        self._use_compact = self._compaction_enabled(cfg)
+        self._use_shape_bucketing = _tristate(
+            getattr(cfg, "shape_bucketing", "auto"),
+            "shape_bucketing") != "false"
         if self._bundle is not None:
             from ..io.bundling import expansion_maps
             src, slot_of = expansion_maps(self._bundle, train_set.bin_mappers,
@@ -458,19 +502,50 @@ class SerialTreeLearner:
             return eligible
         return eligible and jax.default_backend() == "tpu"
 
+    def _compaction_enabled(self, cfg):
+        """Gather-compacted smaller-child histograms (ops/histogram.py
+        compacted_histograms) on the dense masked builder. "auto" turns
+        it on everywhere EXCEPT the TPU masked path, whose pallas
+        streaming kernel already reads HBM at full bandwidth and where
+        random gathers are latency-bound (BASELINE.md); "true" forces
+        it there too. Moot when the leaf-contiguous builder is active —
+        that path is already row-proportional."""
+        mode = _tristate(getattr(cfg, "hist_compaction", "auto"),
+                         "hist_compaction")
+        if self._use_partitioned or mode == "false":
+            return False
+        if mode == "true":
+            return True
+        # single-chunk datasets gain nothing: the one bucket IS the
+        # whole array, so compaction would only add the per-split
+        # gather plus HIST_CHUNK row padding the masked path avoids
+        return (jax.default_backend() != "tpu"
+                and self.num_data > HIST_CHUNK)
+
     # hooks overridden by the parallel learners (parallel/learners.py) -------
+    def _chunk_pad(self, n):
+        """HIST_CHUNK-granular row padding, canonicalized to the
+        shape-bucket grid so nearby dataset sizes reuse one lowered
+        executable from the persistent compile cache."""
+        n_chunks = (n + HIST_CHUNK - 1) // HIST_CHUNK
+        if self._use_shape_bucketing:
+            n_chunks = canonical_row_chunks(n_chunks)
+        return n_chunks * HIST_CHUNK
+
     def _pad_rows(self, n, chunk):
-        if jax.default_backend() == "tpu" or self._use_partitioned:
-            # the pallas/segment histogram kernels grid over fixed
-            # HIST_CHUNK blocks
-            return ((n + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
+        if (jax.default_backend() == "tpu" or self._use_partitioned
+                or self._use_compact):
+            # the pallas/segment/compacted histogram paths grid over
+            # fixed HIST_CHUNK blocks
+            return self._chunk_pad(n)
         return ((n + chunk - 1) // chunk) * chunk if n > chunk else n
 
     def _effective_chunk(self, chunk):
-        if jax.default_backend() == "tpu" or self._use_partitioned:
+        if (jax.default_backend() == "tpu" or self._use_partitioned
+                or self._use_compact):
             # rows are padded to HIST_CHUNK multiples; the XLA-fallback
-            # scan chunk must divide that
-            return min(chunk, HIST_CHUNK)
+            # scan chunk must DIVIDE that
+            return pow2_scan_chunk(chunk)
         return min(chunk, self.n_pad)
 
     def _pad_feature_count(self, f):
@@ -623,6 +698,7 @@ class SerialTreeLearner:
             max_depth=int(cfg.max_depth),
             row_chunk=chunk,
             cache_hists=cache_hists,
+            compact_hist=self._use_compact,
         )
         if getattr(self, "_bundle", None) is None:
             return base

@@ -10,15 +10,36 @@ K_MIN_SCORE = -np.inf
 
 
 class ObjectiveFunction:
-    """Interface (include/LightGBM/objective_function.h:31-32)."""
+    """Interface (include/LightGBM/objective_function.h:31-32).
+
+    Objectives with a jittable gradient also expose the PURE form
+    `_grad_pure(ops, score)` with its device operands `_grad_ops` (a
+    pytree of per-row arrays). The fused trainer (models/gbdt.py
+    _get_fused_fn) feeds those operands as runtime ARGUMENTS instead of
+    letting the jit close over them: closed-over arrays embed their
+    VALUES in the lowered HLO, so any label perturbation would change
+    the program bytes and defeat the persistent compile cache."""
 
     name = "none"
+    _grad_pure = None   # staticmethod-like (ops, score) -> (g, h)
+    _grad_ops = None    # pytree of device operands for _grad_pure
 
     def init(self, metadata, num_data):
         self.num_data = num_data
         self.label = np.asarray(metadata.label, dtype=np.float32)
         self.weights = (None if metadata.weights is None
                         else np.asarray(metadata.weights, dtype=np.float32))
+
+    def _install_grad(self, grad_pure, ops):
+        """Register a pure gradient: adds the optional row weights to
+        `ops`, stores the (_grad_pure, _grad_ops) pair for the fused
+        trainer, and keeps the closed-over jitted `_grad` for the
+        sequential path."""
+        if self.weights is not None:
+            ops["weights"] = jnp.asarray(self.weights)
+        self._grad_ops = ops
+        self._grad_pure = grad_pure
+        self._grad = jax.jit(lambda score: grad_pure(ops, score))
 
     def get_gradients(self, score):
         """score: (K, N) device array -> (grad, hess) each (K, N)."""
@@ -32,21 +53,19 @@ class RegressionL2loss(ObjectiveFunction):
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        label = jnp.asarray(self.label)
-        weights = None if self.weights is None else jnp.asarray(self.weights)
 
-        @jax.jit
-        def _grad(score):
+        def _grad_pure(ops, score):
             s = score[0]
+            weights = ops.get("weights")
             if weights is not None:
-                g = (s - label) * weights
+                g = (s - ops["label"]) * weights
                 h = jnp.broadcast_to(weights, s.shape)
             else:
-                g = s - label
+                g = s - ops["label"]
                 h = jnp.ones_like(s)
             return g[None, :], h[None, :]
 
-        self._grad = _grad
+        self._install_grad(_grad_pure, {"label": jnp.asarray(self.label)})
 
     def get_gradients(self, score):
         return self._grad(score)
@@ -82,24 +101,26 @@ class BinaryLogloss(ObjectiveFunction):
         label_weights[1] *= self.scale_pos_weight
 
         sig = self.sigmoid
-        sign = jnp.asarray(np.where(self.label == 1, 1.0, -1.0), dtype=jnp.float32)
-        lw = jnp.asarray(np.where(self.label == 1, label_weights[1], label_weights[0]),
-                         dtype=jnp.float32)
-        weights = None if self.weights is None else jnp.asarray(self.weights)
 
-        @jax.jit
-        def _grad(score):
+        def _grad_pure(ops, score):
             s = score[0]
+            sign, lw = ops["sign"], ops["lw"]
             response = -2.0 * sign * sig / (1.0 + jnp.exp(2.0 * sign * sig * s))
             abs_response = jnp.abs(response)
             g = response * lw
             h = abs_response * (2.0 * sig - abs_response) * lw
+            weights = ops.get("weights")
             if weights is not None:
                 g = g * weights
                 h = h * weights
             return g[None, :], h[None, :]
 
-        self._grad = _grad
+        self._install_grad(_grad_pure, {
+            "sign": jnp.asarray(np.where(self.label == 1, 1.0, -1.0),
+                                dtype=jnp.float32),
+            "lw": jnp.asarray(np.where(self.label == 1, label_weights[1],
+                                       label_weights[0]), dtype=jnp.float32),
+        })
 
     def get_gradients(self, score):
         return self._grad(score)
@@ -120,21 +141,18 @@ class MulticlassLogloss(ObjectiveFunction):
             Log.fatal("Label must be in [0, %d), but found %d in label",
                       self.num_class, int(label_int.min() if label_int.min() < 0
                                           else label_int.max()))
-        onehot = jnp.asarray(
-            np.eye(self.num_class, dtype=np.float32)[label_int].T)  # (K, N)
-        weights = None if self.weights is None else jnp.asarray(self.weights)
-
-        @jax.jit
-        def _grad(score):
+        def _grad_pure(ops, score):
             p = jax.nn.softmax(score, axis=0)  # (K, N)
-            g = p - onehot
+            g = p - ops["onehot"]
             h = 2.0 * p * (1.0 - p)
+            weights = ops.get("weights")
             if weights is not None:
                 g = g * weights[None, :]
                 h = h * weights[None, :]
             return g, h
 
-        self._grad = _grad
+        self._install_grad(_grad_pure, {"onehot": jnp.asarray(
+            np.eye(self.num_class, dtype=np.float32)[label_int].T)})  # (K, N)
 
     def get_gradients(self, score):
         return self._grad(score)

@@ -86,6 +86,20 @@ def bucket_sizes(n_chunks):
     return sizes
 
 
+def canonical_row_chunks(n_chunks):
+    """Round a HIST_CHUNK-chunk count up to a 3-bit-mantissa grid
+    (m * 2^e, m in [8, 15]) — the shape-bucketing half of the persistent
+    compile cache (config.py setup_compilation_cache): datasets whose
+    padded row counts land in the same bucket share every lowered
+    executable across processes, at <= 1/8 extra padded rows. Counts
+    <= 8 are already canonical (too few distinct values to fragment the
+    cache)."""
+    if n_chunks <= 8:
+        return n_chunks
+    step = 1 << (n_chunks.bit_length() - 4)
+    return -(-n_chunks // step) * step
+
+
 def cover_index(begin, cnt, n_chunks):
     """Chunk-cover dispatch shared by segment_histograms and the
     partition step (models/partitioned.py _partition_segment): the

@@ -41,6 +41,33 @@ def split_destinations(go_left, begin, cnt):
     return dest, n_left
 
 
+def compact_gather_indices(mask, size):
+    """Stable compaction of a row mask into gather indices.
+
+    The gather-compacted histogram engine (ops/histogram.py
+    compacted_histograms) needs the positions of one leaf's rows as a
+    CONTIGUOUS index buffer of static length. This is the same
+    prefix-sum rank idea as split_destinations, applied to a boolean
+    mask: row p's destination is its rank among selected rows, and the
+    scatter drops everything else.
+
+    Args:
+      mask: (N,) bool row selector.
+      size: static buffer length; the caller guarantees
+        sum(mask) <= size (bucketed dispatch, ordered_hist.bucket_sizes).
+
+    Returns (size,) int32 `src` with the selected rows' positions in
+    original order, padded with the out-of-range sentinel N (callers
+    gather with a clamp and zero the padded rows' statistics).
+    """
+    n = mask.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    dest = jnp.where(mask, rank, size)
+    return (jnp.full(size, n, dtype=jnp.int32)
+            .at[dest].set(pos, mode="drop"))
+
+
 def invert_permutation(dest):
     """src such that new[q] = old[src[q]] given new[dest[p]] = old[p]."""
     n = dest.shape[0]

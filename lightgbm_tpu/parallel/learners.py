@@ -48,6 +48,22 @@ from ..utils.log import Log
 
 AXIS = "data"
 
+# shard_map across jax versions: new jax exports jax.shard_map with the
+# `check_vma` knob; older releases (<= 0.4.x, this image's pinned
+# toolchain) ship jax.experimental.shard_map with `check_rep` instead.
+# Same semantics for our use — both knobs only disable the replication-
+# consistency checker.
+if hasattr(jax, "shard_map"):
+    def shard_map(fn, mesh, in_specs, out_specs):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(fn, mesh, in_specs, out_specs):
+        return _exp_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
 
 def pair_allreduce(pair, axis_name=AXIS):
     """Deterministic cross-shard histogram reduction: all_gather both
@@ -124,6 +140,24 @@ class _MeshedTreeLearner(SerialTreeLearner):
         # summation-order ulps by test_parallel.py.
         return super()._partitioned_enabled(cfg)
 
+    def _compaction_enabled(self, cfg):
+        """Row-sharded learners keep gather compaction OPT-IN on the
+        masked builder: shard-local compaction regroups the within-chunk
+        f32 partial sums (chunk boundaries no longer align with the
+        serial learner's), demoting the masked path's chunk-aligned
+        serial == parallel histogram agreement (a few f32 ulps of each
+        cell's absolute mass, the Kahan-pair bound) to ~1e-6 — the
+        reference-grade guarantee the masked data-parallel mode exists
+        to provide. hist_compaction=true accepts that trade; learners
+        with replicated rows (feature-parallel) follow the serial rule
+        since every shard sums the identical compacted buffer."""
+        from ..models.tree_learner import _tristate
+        if (self.shard_rows
+                and _tristate(getattr(cfg, "hist_compaction", "auto"),
+                              "hist_compaction") == "auto"):
+            return False
+        return super()._compaction_enabled(cfg)
+
     def init(self, train_set):
         self.mesh = make_mesh(self.config)
         self.n_shards = self.mesh.devices.size
@@ -147,9 +181,12 @@ class _MeshedTreeLearner(SerialTreeLearner):
         n_max = self.local_rows_max or -(-self.global_num_data // self.n_proc)
         n_max = max(n_max, n)  # never pad below the local row count
         shard = -(-n_max // d_local)
-        if jax.default_backend() == "tpu" or self._use_partitioned:
-            from ..ops.pallas_hist import HIST_CHUNK
-            shard = ((shard + HIST_CHUNK - 1) // HIST_CHUNK) * HIST_CHUNK
+        if (jax.default_backend() == "tpu" or self._use_partitioned
+                or self._use_compact):
+            # per-SHARD padding through the same canonical grid as the
+            # serial learner, computed from the rank-invariant n_max so
+            # every rank lands on identical global shapes
+            shard = self._chunk_pad(shard)
         elif shard > chunk:
             shard = ((shard + chunk - 1) // chunk) * chunk
         return shard * d_local
@@ -157,9 +194,11 @@ class _MeshedTreeLearner(SerialTreeLearner):
     def _effective_chunk(self, chunk):
         if not self.shard_rows:
             return super()._effective_chunk(chunk)
-        if jax.default_backend() == "tpu" or self._use_partitioned:
-            from ..ops.pallas_hist import HIST_CHUNK
-            return min(chunk, HIST_CHUNK)
+        if (jax.default_backend() == "tpu" or self._use_partitioned
+                or self._use_compact):
+            # power-of-two divisor of the HIST_CHUNK row padding
+            from ..models.tree_learner import pow2_scan_chunk
+            return pow2_scan_chunk(chunk)
         # the scan chunk must divide the LOCAL shard length so the
         # (F, nchunks, chunk) reshape stays aligned with the row sharding
         d_local = max(1, self.n_shards // self.n_proc)
@@ -175,11 +214,11 @@ class _MeshedTreeLearner(SerialTreeLearner):
         """The row-sharded learners' common shard_map shape: bins/words
         replicated-by-feature x row-sharded, per-row arrays row-sharded,
         per-feature arrays replicated."""
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=self.mesh,
             in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS),
                       P(None), P(None), P(None)),
-            out_specs=self._out_specs(), check_vma=False)
+            out_specs=self._out_specs())
 
     def _bins_sharding(self):
         if self.shard_features:
@@ -284,12 +323,16 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
         def dp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
             # hist pair-allreduce already yields the GLOBAL histogram on
             # every shard, and root sums are derived from it — so the
-            # scalar-sum hook is identity
+            # scalar-sum hook is identity. Shard-local compaction (opt-
+            # in, _compaction_enabled) keeps the pair contract: each
+            # shard's compacted Kahan pair feeds the same fixed-order
+            # reduction.
             return build_tree_device(
                 bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
                 hist_psum_fn=pair_allreduce,
+                compact_hist=self._use_compact,
                 **self._bundle_kwargs(bins, num_bin_pf))
 
         return self._row_sharded_map(dp_fn)
@@ -383,6 +426,7 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
         params = self.params
         max_depth = int(cfg.max_depth)
         f_loc = self.f_pad // self.n_shards
+        compact = self._use_compact
 
         replicated = self._bins_replicated is not None
         bundled = getattr(self, "_bundle", None) is not None
@@ -464,15 +508,16 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
                 max_depth=max_depth, row_chunk=chunk,
                 sum_psum_fn=sum_bcast,
                 evaluate_fn=evaluate, split_col_fn=split_col,
-                expand_fn=expand if bundled else (lambda h: h))
+                expand_fn=expand if bundled else (lambda h: h),
+                compact_hist=compact)
 
         def wrapped7(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
-            inner = jax.shard_map(
+            inner = shard_map(
                 fp_fn, mesh=self.mesh,
                 in_specs=(P(AXIS, None), P(None), P(None), P(None),
                           P(AXIS), P(AXIS), P(AXIS), P(None), P(None),
                           P(AXIS, None), P(AXIS)),
-                out_specs=self._out_specs(), check_vma=False)
+                out_specs=self._out_specs())
             # dummy stand-ins for paths the traced fn never reads
             bins_full = (self._bins_replicated if replicated
                          else jnp.zeros((1, 1), bins.dtype))
@@ -589,6 +634,7 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 max_depth=max_depth, row_chunk=chunk,
                 sum_psum_fn=psum,
                 evaluate_fn=make_evaluate(fmask, num_bin_pf, is_cat),
+                compact_hist=self._use_compact,
                 **self._bundle_kwargs(bins, num_bin_pf))
 
         return self._row_sharded_map(voting_fn)

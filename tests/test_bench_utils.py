@@ -7,16 +7,20 @@ driver's end-of-round numbers.
 """
 
 import importlib.util
+import os
 import sys
 
 import numpy as np
 import pytest
 
+BENCH_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
 
 @pytest.fixture(scope="module")
 def bench():
     spec = importlib.util.spec_from_file_location(
-        "bench_under_test", "/root/repo/bench.py")
+        "bench_under_test", BENCH_PATH)
     mod = importlib.util.module_from_spec(spec)
     sys.modules["bench_under_test"] = mod
     spec.loader.exec_module(mod)

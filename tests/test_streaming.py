@@ -108,3 +108,65 @@ def test_prefetch_blocks_matches_direct():
         raise RuntimeError("parse failed")
     with pytest.raises(RuntimeError, match="parse failed"):
         list(prefetch_blocks(boom(), depth=2))
+
+
+def test_libsvm_pairs_skips_malformed_tokens():
+    """libsvm_pairs must SKIP malformed tokens (the documented rule) —
+    e.g. ranking-style `qid:3` — on every loader path, instead of
+    aborting a whole streaming load with a ValueError."""
+    from lightgbm_tpu.io.parser import libsvm_pairs
+    assert libsvm_pairs(["1:0.5", "qid:3", "7:2", ":4", "bad",
+                         "2:oops", "-1:9", "3:1e-3"]) \
+        == [(1, 0.5), (7, 2.0), (3, 1e-3)]
+
+
+def _write_wide_libsvm(path, n=30):
+    # feature id far past AUTO_STREAM_MIN_FEATS trips the wide probe
+    with open(path, "w") as f:
+        for i in range(n):
+            f.write(f"{i % 2} 0:{0.5 + i} 2000:1.0\n")
+
+
+def test_wide_libsvm_weight_guard_routes_dense(tmp_path, monkeypatch):
+    """The wide-LibSVM auto-stream route must carry the same
+    weight/group guard as the streamer's sparse_route: with those
+    columns set, _load_two_round would fall back to dense
+    (block, num_cols) parse blocks — multi-GB at probe-tripping widths
+    — so the loader must keep the in-memory path instead."""
+    from lightgbm_tpu.io import dataset as dsmod
+
+    p = tmp_path / "wide.train"
+    _write_wide_libsvm(p)
+    assert dsmod._libsvm_looks_wide(str(p), False)
+
+    monkeypatch.setattr(
+        dsmod.DatasetLoader, "_load_two_round",
+        lambda self, *a, **k: (_ for _ in ()).throw(
+            RuntimeError("streamed")))
+    monkeypatch.setattr(
+        dsmod, "parse_text_file",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("dense")))
+
+    # no weight/group columns: the wide probe auto-streams
+    loader = DatasetLoader(Config.from_params({"objective": "regression"}))
+    with pytest.raises(RuntimeError, match="streamed"):
+        loader.load_from_file(str(p))
+
+    # weight_column set: the guard must route to the in-memory parse
+    loader = DatasetLoader(Config.from_params(
+        {"objective": "regression", "weight_column": "1"}))
+    with pytest.raises(RuntimeError, match="dense"):
+        loader.load_from_file(str(p))
+
+    # ...same for group_column
+    loader = DatasetLoader(Config.from_params(
+        {"objective": "regression", "group_column": "1"}))
+    with pytest.raises(RuntimeError, match="dense"):
+        loader.load_from_file(str(p))
+
+    # explicit use_two_round_loading still wins over the guard
+    loader = DatasetLoader(Config.from_params(
+        {"objective": "regression", "weight_column": "1",
+         "use_two_round_loading": "true"}))
+    with pytest.raises(RuntimeError, match="streamed"):
+        loader.load_from_file(str(p))
