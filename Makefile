@@ -25,7 +25,12 @@ $(TARGET): src_native/c_api_shim.cpp
 test-capi: $(TARGET)
 	$(PYTHON) -m pytest tests/test_c_api.py -q
 
+# fault-injection suite: checkpoint/resume determinism, corrupt-snapshot
+# fallback, non-finite guardrails, distributed-init hardening
+verify-fault:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fault_tolerance.py -q
+
 clean:
 	rm -f $(TARGET)
 
-.PHONY: all test-capi clean
+.PHONY: all test-capi verify-fault clean

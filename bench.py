@@ -324,6 +324,7 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     phases = TIMERS.snapshot()
     _mark("probing per-op phase timings")
     phases.update({k: round(v, 6) for k, v in phase_probe(booster).items()})
+    phases.update(checkpoint_probe(booster, train_s))
     # 1.0 = the fused program's lowering was served by the persistent
     # compile cache (config.py setup_compilation_cache)
     phases["compile_cache_hit"] = float(booster.last_compile_cache_hit)
@@ -404,6 +405,40 @@ def phase_probe(booster):
             out[name] = sorted(times)[1]
         except Exception as e:  # a probe must never cost the result
             _mark(f"phase probe {name} failed: {e}")
+    return out
+
+
+def checkpoint_probe(booster, train_s):
+    """Snapshot-cost microprobe: one FULL checkpoint save (training
+    state capture + serialize + digest + atomic write + rotation,
+    utils/checkpoint.py) timed at the bench's trained model size,
+    median of 3. `checkpoint_overhead_s` is seconds per snapshot;
+    `checkpoint_overhead_pct` is one snapshot as a percentage of the
+    measured train time — the fault-tolerance acceptance bar is <2%
+    at the scaled CPU bench shape (a snapshot_freq cadence of >= 1
+    snapshot per run keeps checkpointing in the noise)."""
+    import shutil
+    import tempfile
+
+    from lightgbm_tpu.utils.checkpoint import CheckpointManager
+
+    out = {}
+    d = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        mgr = CheckpointManager(d, keep_last_k=2)
+        times = []
+        for i in range(3):
+            t0 = time.time()
+            mgr.save(booster.capture_training_state(), booster.iter + i)
+            times.append(time.time() - t0)
+        s = sorted(times)[1]
+        out["checkpoint_overhead_s"] = round(s, 6)
+        if train_s > 0:
+            out["checkpoint_overhead_pct"] = round(100.0 * s / train_s, 4)
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"checkpoint probe failed: {e}")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
     return out
 
 

@@ -14,6 +14,7 @@ has no analog: parallel learners run on the JAX mesh, so
 sockets.
 """
 
+import os
 import time
 
 import numpy as np
@@ -165,9 +166,63 @@ class Application:
         Log.info("Finished loading data in %f seconds", time.time() - start)
 
     def train(self):
-        """application.cpp:222-238."""
+        """application.cpp:222-238.
+
+        With `snapshot_freq` > 0, full training state is checkpointed
+        every `snapshot_freq` iterations (atomic + rotated, see
+        utils/checkpoint.py) and a restart auto-resumes from the newest
+        valid snapshot (`snapshot_resume`), producing the bit-identical
+        model of an uninterrupted run. The fused paths clamp their
+        block size to the snapshot cadence so snapshots land on block
+        boundaries."""
         from .utils.timers import TIMERS
         cfg = self.config
+        manager = None
+        if cfg.snapshot_freq > 0:
+            from .parallel.distributed import process_rank
+            from .utils.checkpoint import CheckpointManager
+            snap_dir = cfg.snapshot_dir or cfg.output_model + ".snapshots"
+            if process_rank() == 0:  # one writer on shared storage
+                manager = CheckpointManager(snap_dir,
+                                            keep_last_k=cfg.snapshot_keep)
+            if cfg.snapshot_resume and os.path.isdir(snap_dir):
+                # every rank restores the same state (the model is
+                # replicated); only rank 0 writes
+                reader = manager or CheckpointManager(
+                    snap_dir, keep_last_k=cfg.snapshot_keep)
+                state, _ = reader.load_latest()
+                if state is not None:
+                    self.boosting.restore_training_state(state)
+            import jax
+            if jax.process_count() > 1:
+                # every rank must restore the SAME iteration: a rank
+                # that cannot see the snapshot dir would cold-start and
+                # silently desync the allreduced histograms
+                from jax.experimental import multihost_utils
+                iters = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([self.boosting.iter],
+                               dtype=np.int64))).reshape(-1)
+                if len({int(v) for v in iters}) != 1:
+                    Log.fatal("snapshot resume desync: ranks restored "
+                              "different iterations %s — snapshot_dir "
+                              "(%s) must be shared storage visible to "
+                              "every rank",
+                              sorted(int(v) for v in iters), snap_dir)
+
+        def maybe_snapshot():
+            b = self.boosting
+            if (manager is not None and b.iter > 0
+                    and b.iter % cfg.snapshot_freq == 0):
+                manager.save(b.capture_training_state(), b.iter)
+
+        def snap_clamp(step):
+            """Clamp a fused block so the next snapshot-cadence point
+            is a block boundary."""
+            if manager is None:
+                return step
+            b = self.boosting
+            boundary = ((b.iter // cfg.snapshot_freq) + 1) * cfg.snapshot_freq
+            return min(step, max(1, boundary - b.iter))
         TIMERS.reset()
         trace_dir = None
         if cfg.profile:
@@ -179,8 +234,19 @@ class Application:
         try:
             fused = getattr(self.boosting, "_fused_eligible", None)
             if fused is not None and fused():
-                # whole boosting block as one device program (gbdt.train_many)
-                self.boosting.train_many(cfg.num_iterations)
+                # whole boosting block as one device program
+                # (gbdt.train_many); snapshotting chops it into
+                # cadence-sized blocks (same trees — block size only
+                # moves the host-sync points)
+                b = self.boosting
+                if manager is None:
+                    b.train_many(cfg.num_iterations - b.iter)
+                else:
+                    stopped = False
+                    while b.iter < cfg.num_iterations and not stopped:
+                        stopped = b.train_many(
+                            snap_clamp(cfg.num_iterations - b.iter))
+                        maybe_snapshot()
                 Log.info("%f seconds elapsed, finished iteration %d (fused)",
                          time.time() - start, self.boosting.iter)
             elif (fused is not None and cfg.metric_freq > 0
@@ -189,15 +255,23 @@ class Application:
                 # run fused blocks of metric_freq iterations, catching up
                 # valid scores from the block's trees and printing between
                 b = self.boosting
-                done = 0
+                done = b.iter
                 while done < cfg.num_iterations:
-                    step = min(cfg.metric_freq, cfg.num_iterations - done)
-                    if step == cfg.metric_freq:
+                    # next boundary on the metric cadence, clamped to
+                    # the snapshot cadence (boundaries land on BOTH, so
+                    # metric output keeps its cadence and snapshots
+                    # theirs; the clamped lengths recur, so at most a
+                    # few scan lengths ever compile)
+                    nxt = min(((done // cfg.metric_freq) + 1)
+                              * cfg.metric_freq, cfg.num_iterations)
+                    step = snap_clamp(nxt - done)
+                    if step == cfg.metric_freq or manager is not None:
                         stopped = b.train_many(step,
                                                ignore_train_metrics=True)
                     else:
-                        # tail shorter than a block: the per-iteration
-                        # loop avoids compiling a second scan length
+                        # one-off tail shorter than a block: the per-
+                        # iteration loop avoids compiling a second scan
+                        # length
                         stopped = False
                         for _ in range(step):
                             if b.train_one_iter(is_eval=False):
@@ -208,15 +282,25 @@ class Application:
                         b.output_metric(done)
                         Log.info("%f seconds elapsed, finished iteration %d "
                                  "(fused block)", time.time() - start, done)
+                    elif not stopped:
+                        # no forward progress (e.g. nonfinite_guard=
+                        # warn_skip skipping a persistently-poisoned
+                        # round): bail instead of spinning forever
+                        Log.warning("no training progress at iteration "
+                                    "%d; stopping", done)
+                        break
                     if stopped:
                         break
+                    maybe_snapshot()
             else:
-                for it in range(1, cfg.num_iterations + 1):
+                for it in range(self.boosting.iter + 1,
+                                cfg.num_iterations + 1):
                     is_finished = self.boosting.train_one_iter(is_eval=True)
                     Log.info("%f seconds elapsed, finished iteration %d",
                              time.time() - start, it)
                     if is_finished:
                         break
+                    maybe_snapshot()
         finally:
             if trace_dir is not None:
                 import jax

@@ -83,6 +83,19 @@ class _RecordEvaluation:
                 data_name, collections.defaultdict(list))
             history[metric_name].append(value)
 
+    # -- checkpoint protocol (callback.checkpoint collects/restores this)
+    def state_dict(self):
+        return {"history": {d: {m: list(v) for m, v in h.items()}
+                            for d, h in self.target.items()}}
+
+    def load_state_dict(self, state):
+        self.target.clear()
+        for data_name, metrics in state.get("history", {}).items():
+            history = self.target.setdefault(
+                data_name, collections.defaultdict(list))
+            for metric_name, values in metrics.items():
+                history[metric_name] = list(values)
+
 
 def record_evaluation(eval_result):
     """Record evaluation history into `eval_result` dict
@@ -143,6 +156,16 @@ class _EarlyStopping:
             [1.0 if entry[3] else -1.0, float("-inf"), 0, ""]
             for entry in env.evaluation_result_list]
 
+    # -- checkpoint protocol (callback.checkpoint collects/restores this)
+    def state_dict(self):
+        return {"trackers": [list(t) for t in self.trackers]
+                if self.trackers is not None else None}
+
+    def load_state_dict(self, state):
+        trackers = state.get("trackers")
+        self.trackers = ([list(t) for t in trackers]
+                         if trackers is not None else None)
+
     def __call__(self, env):
         if self.trackers is None:
             self._start(env)
@@ -169,3 +192,77 @@ def early_stopping(stopping_rounds, verbose=True):
     """Stop when no validation metric improved in `stopping_rounds`
     rounds; checks ALL metrics of all valid sets (callback.py:132-192)."""
     return _EarlyStopping(stopping_rounds, verbose)
+
+
+class _Checkpoint:
+    """Periodic full-state snapshots (utils/checkpoint.py).
+
+    `is_checkpoint` marks it for engine.train: the fused blockwise path
+    keeps this callback OUT of the per-iteration replay (mid-block the
+    model list already holds the whole block's trees, so a mid-block
+    snapshot would capture the future) and instead fires it at block
+    boundaries, clamping the block size to `period` so boundaries land
+    on the snapshot cadence."""
+
+    def __init__(self, manager, period):
+        self.order = 40             # after print/record/early-stop
+        self.is_checkpoint = True
+        self.manager = manager
+        self.period = int(period)
+        self.last_saved_path = None
+        self._peers = ()            # set by engine.train: stateful siblings
+
+    def bind_peers(self, callbacks):
+        """Stateful sibling callbacks (early stopping trackers, eval
+        history) whose state rides inside the snapshot."""
+        self._peers = tuple(cb for cb in callbacks
+                            if cb is not self and hasattr(cb, "state_dict"))
+
+    def save_now(self, booster):
+        """Snapshot the booster's CURRENT state, keyed by its own
+        completed-iteration count (independent of any init_model
+        offset)."""
+        state = booster.gbdt.capture_training_state()
+        state["booster_attrs"] = dict(booster._attr)
+        state["callback_states"] = [
+            (type(cb).__name__, cb.state_dict()) for cb in self._peers]
+        self.last_saved_path = self.manager.save(state, booster.gbdt.iter)
+        return self.last_saved_path
+
+    def restore_into(self, booster, state, all_callbacks):
+        """Apply a loaded snapshot: booster state, attrs, and sibling
+        callback state (matched by class name, in order)."""
+        booster.gbdt.restore_training_state(state)
+        booster._attr = dict(state.get("booster_attrs", {}))
+        saved = list(state.get("callback_states", []))
+        candidates = [cb for cb in all_callbacks
+                      if hasattr(cb, "load_state_dict")]
+        for name, cb_state in saved:
+            for cb in candidates:
+                if type(cb).__name__ == name:
+                    cb.load_state_dict(cb_state)
+                    candidates.remove(cb)
+                    break
+
+    def __call__(self, env):
+        if env.model is None:
+            return  # cv folds have no single resumable state
+        if self.period <= 0:
+            return
+        done = env.model.gbdt.iter
+        if done > 0 and done % self.period == 0:
+            self.save_now(env.model)
+
+
+def checkpoint(directory_or_manager, period=1, keep_last_k=3):
+    """Snapshot full training state every `period` iterations into a
+    rotated, digest-validated checkpoint directory; resume with
+    `engine.train(..., resume_from=...)`. Accepts a directory path or a
+    prebuilt utils.checkpoint.CheckpointManager."""
+    from .utils.checkpoint import CheckpointManager
+    if isinstance(directory_or_manager, CheckpointManager):
+        manager = directory_or_manager
+    else:
+        manager = CheckpointManager(directory_or_manager,
+                                    keep_last_k=keep_last_k)
+    return _Checkpoint(manager, period)

@@ -88,6 +88,10 @@ PARAMETER_ALIASES = {
     "reg_alpha": "lambda_l1",
     "reg_lambda": "lambda_l2",
     "num_classes": "num_class",
+    "save_period": "snapshot_freq",
+    "checkpoint_freq": "snapshot_freq",
+    "checkpoint_dir": "snapshot_dir",
+    "nan_policy": "nonfinite_guard",
 }
 
 
@@ -221,6 +225,20 @@ class Config:
     local_listen_port: int = 12400
     time_out: int = 120
     machine_list_file: str = ""
+    # jax.distributed.initialize hardening (parallel/distributed.py):
+    # retry count and first backoff delay (doubles per retry, capped);
+    # the per-attempt timeout is `time_out` seconds
+    init_retries: int = 3
+    init_backoff_s: float = 1.0
+
+    # --- fault tolerance (utils/checkpoint.py; no reference equivalent) ---
+    snapshot_freq: int = 0     # checkpoint every k iterations (0 = off)
+    snapshot_dir: str = ""     # default: <output_model>.snapshots
+    snapshot_keep: int = 3     # rotation: keep the newest k checkpoints
+    snapshot_resume: bool = True  # CLI auto-resume from newest valid one
+    # NaN/Inf policy for gradients/hessians/scores
+    # (utils/guardrails.py): raise | warn_skip | clamp | off
+    nonfinite_guard: str = "raise"
 
     # derived
     is_parallel: bool = False
@@ -371,6 +389,12 @@ class Config:
               "max_conflict_rate in [0, 1)")
         check(self.num_class >= 1, "num_class should be >= 1")
         check(self.max_position > 0, "max_position should be > 0")
+        check(self.snapshot_freq >= 0, "snapshot_freq should be >= 0")
+        check(self.snapshot_keep >= 1, "snapshot_keep should be >= 1")
+        check(self.init_retries >= 0, "init_retries should be >= 0")
+        from .utils.guardrails import POLICIES
+        check(self.nonfinite_guard in POLICIES,
+              "nonfinite_guard must be one of " + "|".join(POLICIES))
 
     def check_param_conflict(self):
         """Reference config.cpp:139-187."""

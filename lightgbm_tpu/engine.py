@@ -37,13 +37,19 @@ def _split_callbacks(callbacks):
 
 def _train_blockwise(booster, callbacks_after_iter, init_iteration,
                      num_boost_round, is_valid_contain_train, feval,
-                     early_stopping_rounds):
+                     early_stopping_rounds, ckpt_cbs=(), start_offset=0):
     """Fused multi-iteration training with per-iteration callback
     replay (see the blockwise comment in train()). Each block is ONE
     device program (gbdt.train_many_eval); metric values for every
     iteration inside the block come from device-computed score
     snapshots. An early-stop break mid-block drops the overshoot
-    trees scorelessly — the snapshot already IS the kept state."""
+    trees scorelessly — the snapshot already IS the kept state.
+
+    Checkpoint callbacks (`ckpt_cbs`) fire only at BLOCK boundaries:
+    mid-block the model list already holds the whole block's trees, so
+    a mid-block snapshot would capture the future. The block size is
+    clamped (and boundaries aligned) to the snapshot cadence so every
+    cadence point is a block boundary."""
     gbdt = booster.gbdt
     end = init_iteration + num_boost_round
     # overshoot past the true stopping round costs at most block-1
@@ -53,6 +59,17 @@ def _train_blockwise(booster, callbacks_after_iter, init_iteration,
     else:
         block_full = min(num_boost_round,
                          max(5, min(int(early_stopping_rounds), 25)))
+    snap_period = min((cb.period for cb in ckpt_cbs if cb.period > 0),
+                      default=0)
+    if snap_period:
+        block_full = max(1, min(block_full, snap_period))
+
+    def fire_checkpoints(i):
+        for cb in ckpt_cbs:
+            cb(callback.CallbackEnv(
+                model=booster, cvfolds=None, iteration=i,
+                begin_iteration=init_iteration, end_iteration=end,
+                evaluation_result_list=[]))
 
     def run_callbacks(i):
         """One iteration's eval + after-iteration callbacks against the
@@ -71,9 +88,15 @@ def _train_blockwise(booster, callbacks_after_iter, init_iteration,
             return True
         return False
 
-    i = init_iteration
+    i = init_iteration + start_offset
     while i < end:
-        t_eff, snap = gbdt.train_many_eval(min(block_full, end - i))
+        step = min(block_full, end - i)
+        if snap_period:
+            # align boundaries to the cadence (a resume can start the
+            # loop off-cadence only if the newest snapshot did)
+            boundary = ((gbdt.iter // snap_period) + 1) * snap_period
+            step = min(step, max(1, boundary - gbdt.iter))
+        t_eff, snap = gbdt.train_many_eval(step)
         for t in range(t_eff):
             snap.set_scores_at(t, with_train=is_valid_contain_train)
             if run_callbacks(i + t):
@@ -101,6 +124,7 @@ def _train_blockwise(booster, callbacks_after_iter, init_iteration,
                 i += 1
             return
         i += t_eff
+        fire_checkpoints(i - 1)
 
 
 def train(params, train_set, num_boost_round=100,
@@ -108,9 +132,18 @@ def train(params, train_set, num_boost_round=100,
           fobj=None, feval=None, init_model=None,
           feature_name=None, categorical_feature=None,
           early_stopping_rounds=None, evals_result=None,
-          verbose_eval=True, learning_rates=None, callbacks=None):
+          verbose_eval=True, learning_rates=None, callbacks=None,
+          resume_from=None):
     """Train one booster (engine.py:12-191). Returns the Booster with
-    `best_iteration` set when early stopping fired."""
+    `best_iteration` set when early stopping fired.
+
+    resume_from: a checkpoint directory (or CheckpointManager) written
+    by `callback.checkpoint(...)`. When it holds a valid snapshot, full
+    training state (trees, scores, sampling RNG, early-stop trackers,
+    eval history) is restored and the loop continues from the
+    snapshot's iteration — producing the bit-identical model string of
+    an uninterrupted run with the same params and data. No valid
+    snapshot = a normal cold start."""
     if is_str(init_model):
         predictor = _InnerPredictor(model_file=init_model)
     elif isinstance(init_model, Booster):
@@ -174,19 +207,61 @@ def train(params, train_set, num_boost_round=100,
     for valid_set, name_valid_set in zip(reduced_valid_sets, name_valid_sets):
         booster.add_valid(valid_set, name_valid_set)
 
+    all_cbs = callbacks_before_iter + callbacks_after_iter
+    ckpt_cbs = [cb for cb in callbacks_after_iter
+                if getattr(cb, "is_checkpoint", False)]
+    for cb in ckpt_cbs:
+        cb.bind_peers(all_cbs)
+    # resume: restore the newest valid snapshot (trees, score arrays,
+    # RNG streams, callback state) and skip the already-trained rounds
+    start_offset = 0
+    if resume_from is not None:
+        from .utils.checkpoint import CheckpointManager
+        manager = (resume_from if isinstance(resume_from, CheckpointManager)
+                   else CheckpointManager(resume_from))
+        state, _ = manager.load_latest()
+        if state is not None:
+            restorer = ckpt_cbs[0] if ckpt_cbs \
+                else callback._Checkpoint(manager, 0)
+            restorer.restore_into(booster, state, all_cbs)
+            start_offset = min(booster.gbdt.iter, num_boost_round)
+
     # fast path: nothing needs the per-round boundary (no callbacks, no
     # custom objective, no valid evaluation) — run the whole block as
     # the fused device scan (gbdt.train_many); semantics are identical
     # (parity pinned by tests/test_core_training.py and the fused GOSS/
     # bagging tests). The default print_evaluation callback is exempt:
     # with no valid sets its evaluation list is always empty and it
-    # prints nothing (callback.py).
+    # prints nothing (callback.py). Checkpoint callbacks are exempt
+    # too: the scan is chopped into cadence-sized blocks with a
+    # snapshot between blocks (same trees — block size only moves the
+    # host-sync points).
     effective_after = [cb for cb in callbacks_after_iter
-                       if cb is not default_print_cb]
+                       if cb is not default_print_cb and cb not in ckpt_cbs]
     if (not callbacks_before_iter and not effective_after
             and fobj is None and valid_sets is None
             and getattr(booster.gbdt, "_fused_eligible", lambda: False)()):
-        booster.gbdt.train_many(num_boost_round)
+        periods = [cb.period for cb in ckpt_cbs if cb.period > 0]
+        if periods:
+            block = min(periods)
+            stopped = False
+            while booster.gbdt.iter < num_boost_round and not stopped:
+                # align block boundaries to the cadence (a resume can
+                # start off-cadence; fixed-size steps would then never
+                # land on a snapshot point again)
+                boundary = ((booster.gbdt.iter // block) + 1) * block
+                step = min(boundary - booster.gbdt.iter,
+                           num_boost_round - booster.gbdt.iter)
+                stopped = booster.gbdt.train_many(step)
+                for cb in ckpt_cbs:
+                    cb(callback.CallbackEnv(
+                        model=booster, cvfolds=None,
+                        iteration=init_iteration + booster.gbdt.iter - 1,
+                        begin_iteration=init_iteration,
+                        end_iteration=init_iteration + num_boost_round,
+                        evaluation_result_list=[]))
+        elif num_boost_round > start_offset:
+            booster.gbdt.train_many(num_boost_round - start_offset)
         booster.best_iteration = num_boost_round
         return booster
 
@@ -206,15 +281,20 @@ def train(params, train_set, num_boost_round=100,
         valid_sets is not None
         and fobj is None
         and not callbacks_before_iter
-        and all(cb in engine_created for cb in callbacks_after_iter)
+        and all(cb in engine_created or cb in ckpt_cbs
+                for cb in callbacks_after_iter)
         and getattr(booster.gbdt, "_fused_eligible", lambda **_: False)(
             ignore_train_metrics=True))
     if use_blockwise:
-        _train_blockwise(booster, callbacks_after_iter, init_iteration,
+        replay_after = [cb for cb in callbacks_after_iter
+                        if cb not in ckpt_cbs]
+        _train_blockwise(booster, replay_after, init_iteration,
                          num_boost_round, is_valid_contain_train, feval,
-                         early_stopping_rounds)
+                         early_stopping_rounds, ckpt_cbs=ckpt_cbs,
+                         start_offset=start_offset)
     else:
-        for i in range(init_iteration, init_iteration + num_boost_round):
+        for i in range(init_iteration + start_offset,
+                       init_iteration + num_boost_round):
             for cb in callbacks_before_iter:
                 cb(callback.CallbackEnv(model=booster, cvfolds=None, iteration=i,
                                         begin_iteration=init_iteration,

@@ -366,7 +366,12 @@ class CoreDataset:
                 arrays[f"bundle_{k}"] = np.asarray(v)
         for k, v in self.metadata.to_dict().items():
             arrays[f"meta_{k}"] = np.asarray(v)
-        with open(path, "wb") as f:  # keep the exact path (savez appends .npz)
+        from ..utils.checkpoint import atomic_open
+        # crash-atomic: a kill mid-save must never leave a truncated
+        # cache where a valid one stood (the loader would fatal on it).
+        # The archive streams to the tmp file (savez keeps the exact
+        # path; no .npz suffix is appended to an open handle).
+        with atomic_open(path) as f:
             np.savez_compressed(f, magic=np.asarray(BINARY_MAGIC), **arrays)
         Log.info("Saved binary dataset to %s", str(path))
 

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import common
+from ..utils import common, faults, guardrails
 from ..utils.log import Log
 from ..utils.timers import TIMERS
 from .score_updater import ScoreUpdater
@@ -422,6 +422,7 @@ class GBDT:
     # -------------------------------------------------------------- training
     def train_one_iter(self, gradients=None, hessians=None, is_eval=True):
         """gbdt.cpp:210-245. Returns True if training should stop."""
+        faults.crash_if_reached(self.iter)
         if gradients is None or hessians is None:
             if self.objective is None:
                 Log.fatal("No object function provided")
@@ -433,6 +434,19 @@ class GBDT:
                 self.num_class, self.num_data)
             hessians = np.asarray(hessians, dtype=np.float32).reshape(
                 self.num_class, self.num_data)
+        gradients, hessians = faults.poison_gradients_if_armed(
+            self.iter, gradients, hessians)
+        policy = getattr(self.config, "nonfinite_guard", "raise")
+        if policy != "off":
+            gradients, hessians, skip = guardrails.guard_gradients(
+                gradients, hessians, self.iter, policy)
+            if skip:
+                # round skipped: no tree appended and self.iter does NOT
+                # advance (the model list must stay iter*num_class long).
+                # Callers loop over a bounded round count, so a
+                # persistently-poisoned objective stalls progress but
+                # cannot loop forever.
+                return False
         with TIMERS.phase("bagging"):
             inbag = self._bagging(self.iter, gradients, hessians)
         n = self.num_data
@@ -649,6 +663,10 @@ class GBDT:
         before the block. The train score is set to the scan's final
         score (which, at a natural stop, still includes discarded
         trees — callers fix that up)."""
+        # a fused block is ONE device program: a preemption anywhere
+        # inside it loses the whole block, which is exactly what
+        # crashing at its launch models (utils/faults.py)
+        faults.crash_if_reached(self.iter, num_iters)
         fn = self._get_fused_fn(num_iters)
         learner = self.tree_learner
         # same RNG stream and consumption order as the sequential path:
@@ -660,6 +678,12 @@ class GBDT:
         final_score, stacked = fn(self.train_score_updater.score, fmasks,
                                   iters)
         self.train_score_updater.score = final_score
+        policy = getattr(self.config, "nonfinite_guard", "raise")
+        if policy != "off":
+            # in-graph iterations cannot be guarded individually; the
+            # block boundary is where divergence becomes detectable
+            guardrails.guard_scores(np.asarray(final_score),
+                                    self.iter + num_iters, policy)
         host = jax.device_get(stacked)  # ONE transfer for the whole block
         nsp = np.asarray(host["n_splits"]).reshape(num_iters, -1)  # (T, K)
         empty = (nsp == 0).any(axis=1)
@@ -1188,8 +1212,10 @@ class GBDT:
         return "\n".join(lines) + "\n"
 
     def save_model_to_file(self, num_iteration, filename):
-        with open(filename, "w") as f:
-            f.write(self.save_model_to_string(num_iteration))
+        # crash-atomic: a kill mid-save must never leave a truncated
+        # model where a valid one stood (utils/checkpoint.py)
+        from ..utils.checkpoint import atomic_write_text
+        atomic_write_text(filename, self.save_model_to_string(num_iteration))
 
     def load_model_from_string(self, model_str):
         """gbdt.cpp:515-583."""
@@ -1260,6 +1286,131 @@ class GBDT:
         """Booster merge for continued training (gbdt.h:44-61)."""
         self.models = _VersionedList(list(other.models) + self.models)
         self.num_init_iteration += len(other.models) // max(self.num_class, 1)
+
+    # -------------------------------------------------------- checkpointing
+    def _rng_registry(self):
+        """Named stateful HOST RNGs that must survive a resume for
+        bit-identical continuation. Device sampling (bagging, GOSS) is
+        stateless — keyed on the iteration index — so only the numpy
+        streams need capturing: the feature sampler, and DART's drop
+        sampler when present."""
+        regs = {}
+        learner = self.tree_learner
+        if learner is not None and getattr(learner, "random", None) is not None:
+            regs["feature_sampler"] = learner.random
+        if getattr(self, "_random_for_drop", None) is not None:
+            regs["drop_sampler"] = self._random_for_drop
+        return regs
+
+    def capture_training_state(self):
+        """Full mid-training state for utils/checkpoint.py: everything
+        `restore_training_state` needs to continue training on the SAME
+        config + dataset and produce the bit-identical model string of
+        an uninterrupted run. Score arrays are saved verbatim (float32
+        bits) — recomputing them from trees would change summation
+        order and diverge the histogram sums."""
+        state = {
+            "state_version": 1,
+            "model_str": self.save_model_to_string(-1),
+            "iter": int(self.iter),
+            "num_init_iteration": int(self.num_init_iteration),
+            "num_class": int(self.num_class),
+            "train_score": np.asarray(self.train_score_updater.score),
+            "valid_scores": [np.asarray(u.score)
+                             for u in self.valid_score_updaters],
+            "best_iter": [list(map(int, x)) for x in self.best_iter],
+            "best_score": [list(map(float, x)) for x in self.best_score],
+            "best_msg": [list(x) for x in self.best_msg],
+        }
+        for name, rng in self._rng_registry().items():
+            algo, keys, pos, has_gauss, cached = rng._rng.get_state()
+            state[f"rng_{name}"] = {"algo": algo, "pos": int(pos),
+                                    "has_gauss": int(has_gauss),
+                                    "cached": float(cached)}
+            state[f"rng_{name}_keys"] = np.asarray(keys)
+        # bin-space split encoding: the model TEXT stores real-valued
+        # thresholds only, but continued training re-scores restored
+        # trees in bin space (DART's drop/normalize, early-stopping
+        # truncation) — so the in-bin arrays ride along, concatenated
+        # across trees
+        n_splits, tib, sfi = [], [], []
+        for model in self.models:
+            tree = (model.materialize() if hasattr(model, "materialize")
+                    else model)
+            ns = tree.num_leaves - 1
+            n_splits.append(ns)
+            if ns > 0:
+                tib.append(np.asarray(tree.threshold_in_bin[:ns], np.int32))
+                sfi.append(np.asarray(tree.split_feature[:ns], np.int32))
+        state["tree_n_splits"] = np.asarray(n_splits, np.int32)
+        state["tree_threshold_in_bin"] = (
+            np.concatenate(tib) if tib else np.zeros(0, np.int32))
+        state["tree_split_feature_inner"] = (
+            np.concatenate(sfi) if sfi else np.zeros(0, np.int32))
+        return state
+
+    def restore_training_state(self, state):
+        """Inverse of `capture_training_state`, applied to a freshly
+        initialized booster bound to the same config/datasets."""
+        if int(state.get("state_version", 0)) != 1:
+            Log.fatal("Unsupported checkpoint state version %s",
+                      state.get("state_version"))
+        if int(state["num_class"]) != self.num_class:
+            Log.fatal("Checkpoint num_class %d does not match booster "
+                      "num_class %d", int(state["num_class"]), self.num_class)
+        n_valid = len(state.get("valid_scores", []))
+        if n_valid != len(self.valid_score_updaters):
+            Log.fatal("Checkpoint has %d valid-set scores but booster has "
+                      "%d valid sets bound", n_valid,
+                      len(self.valid_score_updaters))
+        self.load_model_from_string(state["model_str"])
+        # re-attach the bin-space split encoding the text format drops
+        # (see capture_training_state)
+        n_splits = np.asarray(state.get("tree_n_splits", []), np.int32)
+        if len(n_splits) == len(self.models):
+            offsets = np.concatenate([[0], np.cumsum(n_splits)])
+            tib = np.asarray(state["tree_threshold_in_bin"], np.int32)
+            sfi = np.asarray(state["tree_split_feature_inner"], np.int32)
+            for idx, tree in enumerate(self.models):
+                lo, hi = offsets[idx], offsets[idx + 1]
+                if hi > lo:
+                    tree.threshold_in_bin = tib[lo:hi].copy()
+                    tree.split_feature = sfi[lo:hi].copy()
+        # load_model_from_string prepares for PREDICTION (treats every
+        # tree as an init tree); a resume continues TRAINING, so the
+        # split between init trees and this run's own is the captured one
+        self.num_init_iteration = int(state["num_init_iteration"])
+        self.num_iteration_for_pred = 0
+        self.iter = int(state["iter"])
+        train_score = np.asarray(state["train_score"], dtype=np.float32)
+        if train_score.shape != tuple(self.train_score_updater.score.shape):
+            Log.fatal("Checkpoint train-score shape %s does not match "
+                      "dataset shape %s (different training data?)",
+                      train_score.shape,
+                      tuple(self.train_score_updater.score.shape))
+        self.train_score_updater.score = jnp.asarray(train_score)
+        for updater, score in zip(self.valid_score_updaters,
+                                  state["valid_scores"]):
+            updater.score = jnp.asarray(np.asarray(score, dtype=np.float32))
+        self.best_iter = [list(x) for x in state.get("best_iter", [])]
+        self.best_score = [list(x) for x in state.get("best_score", [])]
+        self.best_msg = [list(x) for x in state.get("best_msg", [])]
+        for name, rng in self._rng_registry().items():
+            meta = state.get(f"rng_{name}")
+            keys = state.get(f"rng_{name}_keys")
+            if meta is None or keys is None:
+                continue
+            rng._rng.set_state((meta["algo"],
+                                np.asarray(keys, dtype=np.uint32),
+                                int(meta["pos"]), int(meta["has_gauss"]),
+                                float(meta["cached"])))
+        # bag cache and prediction caches may describe pre-restore state
+        self._bag_rows = None
+        self._bag_window = None
+        self._stack_cache = None
+        self._dev_model_cache = None
+        Log.info("Restored training state at iteration %d (%d trees)",
+                 self.iter, len(self.models))
 
 
 def create_boosting(boosting_type, input_model=""):

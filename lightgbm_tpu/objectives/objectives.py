@@ -29,6 +29,11 @@ class ObjectiveFunction:
         self.label = np.asarray(metadata.label, dtype=np.float32)
         self.weights = (None if metadata.weights is None
                         else np.asarray(metadata.weights, dtype=np.float32))
+        # guardrail: a NaN/Inf label or weight poisons every gradient of
+        # every iteration — fail at init with the offending row instead
+        # of training garbage trees (utils/guardrails.py)
+        from ..utils.guardrails import validate_labels
+        validate_labels(self.label, self.weights)
 
     def _install_grad(self, grad_pure, ops):
         """Register a pure gradient: adds the optional row weights to

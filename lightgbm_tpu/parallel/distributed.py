@@ -20,27 +20,68 @@ overrides (needed e.g. for multiple ranks on one host).
 
 import os
 import socket
+import time
 
 import jax
 import numpy as np
 
+from ..utils import faults
 from ..utils.log import Log
 
 _initialized = False
 
 
+def _split_host_port(token, lineno):
+    """One `host:port` token -> (host, port_str), IPv6-safe: bracketed
+    `[addr]:port` is the canonical v6 form; a bare single-colon token is
+    `host:port`; multiple colons without brackets is an IPv6 address
+    with no parseable port — a hard error, not a silent mangle."""
+    if token.startswith("["):
+        host, bracket, port = token.partition("]")
+        if not bracket or not port.startswith(":") or not port[1:]:
+            Log.fatal("Machine list file parse error at line %d: %r "
+                      "(bracketed IPv6 must be '[addr]:port')",
+                      lineno, token)
+        return host[1:], port[1:]
+    if token.count(":") == 1:
+        host, _, port = token.partition(":")
+        return host, port
+    Log.fatal("Machine list file parse error at line %d: %r (IPv6 "
+              "addresses need '[addr]:port' or 'addr port')",
+              lineno, token)
+
+
 def parse_machine_list(path):
-    """`ip port` (or `ip:port`) lines -> [(ip, port)] (linkers_socket.cpp:36-56)."""
+    """`ip port` (or `ip:port`) lines -> [(ip, port)]
+    (linkers_socket.cpp:36-56). `#` starts a comment; IPv6 addresses
+    use `[addr]:port` or `addr port`; repeated entries are deduped
+    (keeping first occurrence — duplicate lines in hand-edited lists
+    must not inflate the rank count)."""
     machines = []
+    seen = set()
     with open(path) as f:
-        for line in f:
-            line = line.strip().replace(":", " ")
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
             if not line:
                 continue
             parts = line.split()
-            if len(parts) < 2:
-                Log.fatal("Machine list file parse error: %s", line)
-            machines.append((parts[0], int(parts[1])))
+            if len(parts) >= 2:
+                host, port = parts[0], parts[1]
+            else:
+                host, port = _split_host_port(parts[0], lineno)
+            if host.startswith("[") and host.endswith("]"):
+                host = host[1:-1]
+            try:
+                port = int(port)
+            except ValueError:
+                Log.fatal("Machine list file parse error at line %d: "
+                          "port %r is not an integer", lineno, port)
+            if (host, port) in seen:
+                Log.warning("machine list line %d duplicates %s:%d; "
+                            "ignoring", lineno, host, port)
+                continue
+            seen.add((host, port))
+            machines.append((host, port))
     return machines
 
 
@@ -62,6 +103,62 @@ def find_local_rank(machines):
         if ip in local:
             return i
     Log.fatal("Machine list file doesn't contain the local machine")
+
+
+def _call_initialize(coordinator, num_processes, rank, timeout_s):
+    """One jax.distributed.initialize attempt. Split out so the fault
+    harness (`fail_distributed_init`) and tests can intercept it."""
+    if faults.consume("fail_distributed_init"):
+        raise RuntimeError("injected distributed-init failure")
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=rank,
+                                   initialization_timeout=timeout_s)
+    except TypeError:
+        # older jax without initialization_timeout
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=rank)
+
+
+def _initialize_with_retry(coordinator, num_processes, rank, retries=3,
+                           backoff_s=1.0, timeout_s=120):
+    """jax.distributed.initialize with a per-attempt timeout and
+    exponential-backoff retries (TPU fleets routinely restart the
+    coordinator pod first; a transient connect failure must not kill
+    every worker). Returns True on success, False when the backend was
+    already initialized externally; fatal when retries are exhausted."""
+    delay = max(0.0, float(backoff_s))
+    last_error = None
+    for attempt in range(int(retries) + 1):
+        try:
+            _call_initialize(coordinator, num_processes, rank, timeout_s)
+            if attempt:
+                Log.info("jax.distributed.initialize succeeded on "
+                         "attempt %d", attempt + 1)
+            return True
+        except RuntimeError as e:
+            msg = str(e)
+            # jax 0.4.x raises "distributed.initialize should only be
+            # called once."; other versions say "already initialized"
+            if ("already" in msg.lower()
+                    or "only be called once" in msg.lower()):
+                # backend already up (e.g. an external launcher
+                # initialized distributed itself) — keep going with it
+                Log.warning("jax.distributed.initialize skipped: %s", msg)
+                return False
+            last_error = msg
+        if attempt < retries:
+            Log.warning("jax.distributed.initialize failed (attempt "
+                        "%d/%d): %s; retrying in %.1fs", attempt + 1,
+                        retries + 1, last_error, delay)
+            if delay > 0:
+                time.sleep(delay)
+            delay = min(delay * 2 if delay > 0 else 1.0, 30.0)
+    Log.fatal("jax.distributed.initialize failed after %d attempts "
+              "(coordinator %s, rank %d of %d): %s", retries + 1,
+              coordinator, rank, num_processes, last_error)
 
 
 def init_from_config(config):
@@ -95,17 +192,23 @@ def init_from_config(config):
     machines = machines[:config.num_machines]
     env_rank = os.environ.get("LIGHTGBM_TPU_RANK")
     rank = int(env_rank) if env_rank is not None else find_local_rank(machines)
+    if not 0 <= rank < config.num_machines:
+        # a wrong LIGHTGBM_TPU_RANK (or a machine list edited out from
+        # under a running job) must die loudly HERE: passing it through
+        # would hang every healthy peer in the coordinator handshake
+        Log.fatal("rank %d is out of range for num_machines=%d "
+                  "(machine list %s has %d usable entries); check "
+                  "LIGHTGBM_TPU_RANK against the machine list",
+                  rank, config.num_machines, config.machine_list_file,
+                  len(machines))
     coordinator = f"{machines[0][0]}:{machines[0][1]}"
-    try:
-        # NOTE: must run before anything initializes the XLA backend —
-        # do not touch jax.devices()/process_count() above this line
-        jax.distributed.initialize(coordinator_address=coordinator,
-                                   num_processes=config.num_machines,
-                                   process_id=rank)
-    except RuntimeError as e:
-        # backend already up (e.g. running under an external launcher
-        # that initialized distributed itself) — keep going with it
-        Log.warning("jax.distributed.initialize skipped: %s", str(e))
+    # NOTE: must run before anything initializes the XLA backend —
+    # do not touch jax.devices()/process_count() above this line
+    if not _initialize_with_retry(coordinator, config.num_machines, rank,
+                                  retries=getattr(config, "init_retries", 3),
+                                  backoff_s=getattr(config, "init_backoff_s",
+                                                    1.0),
+                                  timeout_s=getattr(config, "time_out", 120)):
         return False
     _initialized = True
     Log.info("Distributed: rank %d of %d (coordinator %s), %d global devices",

@@ -1,0 +1,165 @@
+"""Fault-injection harness for the fault-tolerance subsystem.
+
+No reference equivalent: the reference's recovery story is "rerun the
+job". Here preemption-safety is a first-class feature, so each recovery
+path (checkpoint/resume, digest validation, non-finite guardrails,
+distributed-init retry) carries an injection point this module drives,
+and tests/test_fault_tolerance.py proves every path end-to-end.
+
+Activation is env- or API-driven:
+
+- env: ``LIGHTGBM_TPU_FAULTS="crash_at_iteration=5,corrupt_digest=1"``
+  (read once per process at import; re-read with `reload_from_env`).
+- API: ``faults.set_fault("crash_at_iteration", 5)`` / `clear_faults()`
+  (what the test suite uses; `injected_faults` is the context-manager
+  form that always restores the previous state).
+
+Known fault names (value semantics in parentheses):
+
+- ``crash_at_iteration`` (iteration index): raise `InjectedFault` —
+  or `os._exit(43)` when ``hard_crash`` is also set — just before
+  boosting iteration k trains (models/gbdt.py; a fused block containing
+  iteration k crashes at its block boundary, the preemption analog).
+- ``nan_grad_at_iteration`` (iteration index): poison the gradients of
+  iteration k with NaN (models/gbdt.py), exercising the
+  `nonfinite_guard` policy.
+- ``truncate_checkpoint`` (count): the next k checkpoint saves write
+  only the first half of the file's bytes (utils/checkpoint.py).
+- ``corrupt_digest`` (count): the next k checkpoint saves flip a
+  payload byte after the digest was computed (utils/checkpoint.py).
+- ``fail_distributed_init`` (count): the next k attempts of
+  `jax.distributed.initialize` fail (parallel/distributed.py).
+- ``hard_crash`` (flag): escalate `crash_at_iteration` from a Python
+  exception to `os._exit(43)` — a true no-cleanup kill, the closest
+  in-process analog of a TPU preemption.
+"""
+
+import os
+
+ENV_VAR = "LIGHTGBM_TPU_FAULTS"
+
+# exit code of a hard_crash kill; tests assert on it
+HARD_CRASH_EXIT_CODE = 43
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed injection point (soft crash mode)."""
+
+
+_active = {}
+
+
+def _parse_spec(spec):
+    out = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, value = item.partition("=")
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            out[name] = int(value) if value else 1
+        except ValueError:
+            out[name] = value.strip()
+    return out
+
+
+def reload_from_env():
+    """Replace the active fault set with $LIGHTGBM_TPU_FAULTS."""
+    _active.clear()
+    _active.update(_parse_spec(os.environ.get(ENV_VAR, "")))
+
+
+def set_fault(name, value=1):
+    _active[name] = value
+
+
+def clear_faults():
+    _active.clear()
+
+
+def active():
+    return dict(_active)
+
+
+def get(name, default=None):
+    return _active.get(name, default)
+
+
+def consume(name):
+    """Count-based faults: True (and decrement) while the counter is
+    positive; a negative counter fires forever."""
+    count = _active.get(name)
+    if not isinstance(count, int) or count == 0:
+        return False
+    if count > 0:
+        _active[name] = count - 1
+    return True
+
+
+class injected_faults:
+    """Context manager arming a fault set and restoring the previous
+    state on exit (the test suite's idiom)."""
+
+    def __init__(self, **faults):
+        self._faults = faults
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = dict(_active)
+        _active.update(self._faults)
+        return self
+
+    def __exit__(self, *exc):
+        _active.clear()
+        _active.update(self._saved)
+        return False
+
+
+# ------------------------------------------------------------ fire points
+
+def crash_if_reached(first_iteration, num_iterations=1):
+    """Crash when `crash_at_iteration` falls inside
+    [first_iteration, first_iteration + num_iterations). Called at the
+    start of every boosting iteration (per-iteration path) and at every
+    fused block launch (the whole block is one device program, so a
+    preemption mid-block loses the block — crashing at its start models
+    exactly that)."""
+    k = _active.get("crash_at_iteration")
+    if not isinstance(k, int):
+        return
+    if first_iteration <= k < first_iteration + num_iterations:
+        if _active.get("hard_crash"):
+            os._exit(HARD_CRASH_EXIT_CODE)
+        raise InjectedFault(
+            f"injected crash at boosting iteration {k}")
+
+
+def poison_gradients_if_armed(iteration, gradients, hessians):
+    """When `nan_grad_at_iteration` == iteration, return copies of
+    (gradients, hessians) with NaN planted in class 0 (row index
+    `nan_grad_row`, default 3, clamped to the array)."""
+    k = _active.get("nan_grad_at_iteration")
+    if not isinstance(k, int) or k != iteration:
+        return gradients, hessians
+    import numpy as np
+    g = np.array(gradients, dtype=np.float32, copy=True)
+    row = min(int(_active.get("nan_grad_row", 3)), g.shape[-1] - 1)
+    g.reshape(g.shape[0] if g.ndim > 1 else 1, -1)[0, row] = np.nan
+    return g, hessians
+
+
+def mangle_checkpoint_blob(blob):
+    """Apply `truncate_checkpoint` / `corrupt_digest` to the final
+    checkpoint file bytes. Returns the (possibly mangled) bytes."""
+    if consume("truncate_checkpoint"):
+        blob = blob[:max(1, len(blob) // 2)]
+    if consume("corrupt_digest"):
+        flip = len(blob) - 1  # payload tail: past header and digest
+        blob = blob[:flip] + bytes([blob[flip] ^ 0xFF])
+    return blob
+
+
+reload_from_env()

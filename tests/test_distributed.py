@@ -13,8 +13,15 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 BINARY_TRAIN = "/root/reference/examples/binary_classification/binary.train"
+
+# environment gate: every test here trains on the reference checkout's
+# example data, which is not part of this repo
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BINARY_TRAIN),
+    reason=f"requires reference example data at {BINARY_TRAIN}")
 
 
 def _free_port():

@@ -14,6 +14,11 @@ import pytest
 
 GUIDE = "/root/reference/examples/python-guide"
 
+# environment gate: runs the reference checkout's own example scripts
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(GUIDE),
+    reason=f"requires reference python-guide scripts at {GUIDE}")
+
 
 def _run_guide_script(name, tmp_path, monkeypatch):
     import lightgbm_tpu

@@ -4,6 +4,8 @@ Reference behavior: src/io/dataset_loader.cpp:505-610 (two-round load),
 include/LightGBM/utils/text_reader.h (count/sample/filtered reads).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -19,6 +21,8 @@ REF_EXAMPLES = "/root/reference/examples"
     f"{REF_EXAMPLES}/lambdarank/rank.train",                # libsvm + query
 ])
 def test_two_round_matches_in_memory(data):
+    if not os.path.exists(data):
+        pytest.skip(f"requires reference example data at {data}")
     cfg1 = Config.from_params({"use_two_round_loading": False,
                                "enable_load_from_binary_file": False})
     cfg2 = Config.from_params({"use_two_round_loading": True,
