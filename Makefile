@@ -30,7 +30,15 @@ test-capi: $(TARGET)
 verify-fault:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fault_tolerance.py -q
 
+# distributed supervisor suite: heartbeat expiry, watchdog-armed
+# collective timeout, rank-crash -> supervisor restart -> model parity,
+# shrunken-world restart — real two-process jax.distributed runs on
+# CPU, under a hard timeout so a regression can never hang CI
+verify-dist:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_supervisor.py tests/test_distributed.py -q
+
 clean:
 	rm -f $(TARGET)
 
-.PHONY: all test-capi verify-fault clean
+.PHONY: all test-capi verify-fault verify-dist clean

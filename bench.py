@@ -325,6 +325,7 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     _mark("probing per-op phase timings")
     phases.update({k: round(v, 6) for k, v in phase_probe(booster).items()})
     phases.update(checkpoint_probe(booster, train_s))
+    phases.update(supervisor_probe())
     # 1.0 = the fused program's lowering was served by the persistent
     # compile cache (config.py setup_compilation_cache)
     phases["compile_cache_hit"] = float(booster.last_compile_cache_hit)
@@ -437,6 +438,47 @@ def checkpoint_probe(booster, train_s):
             out["checkpoint_overhead_pct"] = round(100.0 * s / train_s, 4)
     except Exception as e:  # a probe must never cost the result
         _mark(f"checkpoint probe failed: {e}")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
+def supervisor_probe():
+    """Heartbeat-cost microprobe (parallel/heartbeat.py): one full
+    publish+scan cycle (atomic JSON write + peer-file reads + staleness
+    bookkeeping) timed against a 4-rank shared dir, median of 30.
+    `heartbeat_cycle_s` is seconds per cycle; `supervisor_overhead_pct`
+    is the steady-state cost as a percentage of wall time at the
+    DEFAULT cadence (one cycle per `timeout/4` with timeout=60s) — the
+    acceptance bar is <1% of train time, alongside the checkpoint
+    probe's `checkpoint_overhead_pct`."""
+    import shutil
+    import tempfile
+
+    from lightgbm_tpu.parallel.heartbeat import HeartbeatService
+
+    out = {}
+    d = tempfile.mkdtemp(prefix="bench_hb_")
+    try:
+        ranks = [HeartbeatService(d, r, 4, timeout_s=60.0)
+                 for r in range(4)]
+        for svc in ranks:
+            svc.publish()
+        probe = ranks[0]
+        times = []
+        for _ in range(30):
+            t0 = time.time()
+            probe.publish()
+            probe.scan()
+            probe.dead_peers()
+            times.append(time.time() - t0)
+        cycle_s = sorted(times)[len(times) // 2]
+        out["heartbeat_cycle_s"] = round(cycle_s, 6)
+        # default cadence: one cycle per (timeout / 4) seconds
+        out["supervisor_overhead_pct"] = round(
+            100.0 * cycle_s / (60.0 / 4.0), 6)
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"supervisor probe failed: {e}")
     finally:
         shutil.rmtree(d, ignore_errors=True)
     return out

@@ -47,10 +47,11 @@ class Predictor:
         return self.boosting.predict(feats, self.num_iteration)
 
     def predict_file(self, data_filename, result_filename, has_header=False,
-                     label_column=""):
+                     label_column="", max_bad_rows=0):
         from .io.parser import parse_text_file
         _, feats, _, _, _ = parse_text_file(
-            data_filename, has_header=has_header, label_column=label_column)
+            data_filename, has_header=has_header, label_column=label_column,
+            max_bad_rows=max_bad_rows)
         out = np.atleast_2d(self.predict_matrix(feats))
         with open(result_filename, "w") as fout:
             for row in out:
@@ -177,23 +178,53 @@ class Application:
         boundaries."""
         from .utils.timers import TIMERS
         cfg = self.config
+        import jax
+        from .parallel import heartbeat
+        # shared scratch dir: snapshots, heartbeats, watchdog markers,
+        # supervisor restart barrier all live under it
+        snap_dir = cfg.snapshot_dir or cfg.output_model + ".snapshots"
+        if cfg.heartbeat_timeout_s > 0 or cfg.collective_timeout_s > 0:
+            # heartbeat publisher + peer monitor (multi-process) and/or
+            # the collective watchdog (parallel/heartbeat.py): a dead or
+            # straggling rank is detected within a bounded time instead
+            # of hanging every survivor in a jax.lax collective forever
+            heartbeat.configure(
+                cfg, snap_dir, jax.process_index(), jax.process_count(),
+                iteration_fn=lambda: self.boosting.iter)
         manager = None
         if cfg.snapshot_freq > 0:
             from .parallel.distributed import process_rank
             from .utils.checkpoint import CheckpointManager
-            snap_dir = cfg.snapshot_dir or cfg.output_model + ".snapshots"
             if process_rank() == 0:  # one writer on shared storage
                 manager = CheckpointManager(snap_dir,
                                             keep_last_k=cfg.snapshot_keep)
+            state = None
             if cfg.snapshot_resume and os.path.isdir(snap_dir):
                 # every rank restores the same state (the model is
                 # replicated); only rank 0 writes
                 reader = manager or CheckpointManager(
                     snap_dir, keep_last_k=cfg.snapshot_keep)
                 state, _ = reader.load_latest()
-                if state is not None:
-                    self.boosting.restore_training_state(state)
             import jax
+            if jax.process_count() > 1:
+                # agree on the resume point BEFORE the restore: the
+                # multi-host restore itself runs collectives (global
+                # score re-slice, models/gbdt.py), so a rank that
+                # cannot see the snapshot dir must fail fast HERE —
+                # otherwise its desync-check allgather below would
+                # pair with the restoring ranks' restore collectives
+                from jax.experimental import multihost_utils
+                found = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([state["iter"] if state is not None
+                                else -1], dtype=np.int64))).reshape(-1)
+                if len({int(v) for v in found}) != 1:
+                    Log.fatal("snapshot resume desync: ranks found "
+                              "different snapshots (iterations %s) — "
+                              "snapshot_dir (%s) must be shared "
+                              "storage visible to every rank",
+                              sorted(int(v) for v in found), snap_dir)
+            if state is not None:
+                self.boosting.restore_training_state(state)
             if jax.process_count() > 1:
                 # every rank must restore the SAME iteration: a rank
                 # that cannot see the snapshot dir would cold-start and
@@ -211,9 +242,32 @@ class Application:
 
         def maybe_snapshot():
             b = self.boosting
-            if (manager is not None and b.iter > 0
-                    and b.iter % cfg.snapshot_freq == 0):
-                manager.save(b.capture_training_state(), b.iter)
+            if (cfg.snapshot_freq <= 0 or b.iter <= 0
+                    or b.iter % cfg.snapshot_freq):
+                return
+            import jax
+            if manager is None and jax.process_count() <= 1:
+                return
+            # multi-host row-sharded capture is COLLECTIVE (the global
+            # train score is allgathered, models/gbdt.py), so every
+            # rank captures at the cadence point; only rank 0 writes
+            state = b.capture_training_state()
+            if manager is not None:
+                path = manager.save(state, b.iter)
+                heartbeat.notify_checkpoint(b.iter, path)
+            if jax.process_count() > 1:
+                # hold every rank HERE while rank 0 writes, under a
+                # guard that NAMES the snapshot barrier: otherwise the
+                # peers would spend rank 0's checkpoint I/O blocked in
+                # the next iteration's collective, and a slow shared-
+                # storage write would fire their watchdogs with a
+                # misleading hung-collective diagnosis.
+                # `collective_timeout_s` must therefore also cover the
+                # worst-case snapshot write (docs/Parameters.md).
+                from jax.experimental import multihost_utils
+                with heartbeat.collective_guard("snapshot_write_barrier"):
+                    multihost_utils.process_allgather(
+                        np.asarray([b.iter], dtype=np.int64))
 
         def snap_clamp(step):
             """Clamp a fused block so the next snapshot-cadence point
@@ -311,6 +365,9 @@ class Application:
         import jax
         if jax.process_index() == 0:  # every rank has the identical model
             self.boosting.save_model_to_file(-1, cfg.output_model)
+        # final `done` beat + monitor stop: a cleanly finished rank must
+        # never be declared dead by peers still tearing down
+        heartbeat.shutdown(done=True)
         Log.info("Finished training")
 
     # ------------------------------------------------------------ prediction
@@ -332,7 +389,8 @@ class Application:
             num_iteration=cfg.num_iteration_predict)
         predictor.predict_file(cfg.data, cfg.output_result,
                                has_header=cfg.has_header,
-                               label_column=cfg.label_column)
+                               label_column=cfg.label_column,
+                               max_bad_rows=cfg.max_bad_rows)
         Log.info("Finished prediction")
 
 

@@ -227,6 +227,10 @@ class _Checkpoint:
         state["callback_states"] = [
             (type(cb).__name__, cb.state_dict()) for cb in self._peers]
         self.last_saved_path = self.manager.save(state, booster.gbdt.iter)
+        # supervisor heartbeats advertise the newest resumable snapshot
+        # (parallel/heartbeat.py); no-op when no service is running
+        from .parallel import heartbeat
+        heartbeat.notify_checkpoint(booster.gbdt.iter, self.last_saved_path)
         return self.last_saved_path
 
     def restore_into(self, booster, state, all_callbacks):

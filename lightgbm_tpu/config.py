@@ -231,6 +231,20 @@ class Config:
     init_retries: int = 3
     init_backoff_s: float = 1.0
 
+    # --- distributed supervisor (parallel/heartbeat.py, supervisor.py;
+    # no reference equivalent) ---
+    # peer declared dead after this many seconds without a heartbeat
+    # change (0 = heartbeats off); beats publish every timeout/4
+    heartbeat_timeout_s: float = 0.0
+    # watchdog around blocking collectives: abort (exit code 117) when a
+    # device-sync point blocks longer than this (0 = off). Must exceed
+    # the worst-case legitimate sync, including a first-iteration compile
+    collective_timeout_s: float = 0.0
+    # elastic-restart launcher (`python -m lightgbm_tpu.supervisor`):
+    # relaunch after a failure, at most max_restarts times
+    restart_on_failure: bool = True
+    max_restarts: int = 2
+
     # --- fault tolerance (utils/checkpoint.py; no reference equivalent) ---
     snapshot_freq: int = 0     # checkpoint every k iterations (0 = off)
     snapshot_dir: str = ""     # default: <output_model>.snapshots
@@ -239,6 +253,9 @@ class Config:
     # NaN/Inf policy for gradients/hessians/scores
     # (utils/guardrails.py): raise | warn_skip | clamp | off
     nonfinite_guard: str = "raise"
+    # CSV/TSV ingestion: quarantine up to this many malformed rows
+    # (io/parser.py) instead of failing on the first one; 0 = strict
+    max_bad_rows: int = 0
 
     # derived
     is_parallel: bool = False
@@ -392,6 +409,12 @@ class Config:
         check(self.snapshot_freq >= 0, "snapshot_freq should be >= 0")
         check(self.snapshot_keep >= 1, "snapshot_keep should be >= 1")
         check(self.init_retries >= 0, "init_retries should be >= 0")
+        check(self.heartbeat_timeout_s >= 0,
+              "heartbeat_timeout_s should be >= 0")
+        check(self.collective_timeout_s >= 0,
+              "collective_timeout_s should be >= 0")
+        check(self.max_restarts >= 0, "max_restarts should be >= 0")
+        check(self.max_bad_rows >= 0, "max_bad_rows should be >= 0")
         from .utils.guardrails import POLICIES
         check(self.nonfinite_guard in POLICIES,
               "nonfinite_guard must be one of " + "|".join(POLICIES))

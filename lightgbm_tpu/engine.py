@@ -202,6 +202,20 @@ def train(params, train_set, num_boost_round=100,
     callbacks_before_iter, callbacks_after_iter = _split_callbacks(callbacks)
 
     booster = Booster(params=params, train_set=train_set)
+    # late-bind the supervisor heartbeat's progress source: an embedder
+    # that enabled heartbeats (parallel/heartbeat.py configure) gets
+    # per-iteration liveness from this booster; no-op otherwise. Weakly
+    # referenced: the process-lifetime service must not keep a dropped
+    # booster (dataset bins, score arrays) alive after train() returns.
+    import weakref
+    from .parallel import heartbeat
+    gbdt_ref = weakref.ref(booster.gbdt)
+
+    def _iteration_source():
+        gbdt = gbdt_ref()
+        return gbdt.iter if gbdt is not None else -1
+
+    heartbeat.bind_iteration_source(_iteration_source)
     if is_valid_contain_train:
         booster.set_train_data_name(train_data_name)
     for valid_set, name_valid_set in zip(reduced_valid_sets, name_valid_sets):

@@ -35,6 +35,21 @@ from .metadata import Metadata
 from .parser import parse_text_file, ZERO_THRESHOLD
 
 BINARY_MAGIC = "lightgbm_tpu_dataset_v1"
+BINARY_FORMAT_VERSION = 1
+_ZIP_MAGIC = b"PK\x03\x04"  # npz container prefix
+
+
+class BinaryDatasetError(Exception):
+    """A binary dataset file failed validation. `claimed` is True when
+    the file LOOKS like a binary dataset (npz container) but is
+    truncated/corrupt/foreign — as opposed to a text file that was
+    never binary at all — so callers can fall past a rotten cache with
+    a warning (mirroring the checkpoint loader's behavior) while
+    staying silent for ordinary text data files."""
+
+    def __init__(self, message, claimed=False):
+        super().__init__(message)
+        self.claimed = claimed
 
 
 def _qid_to_counts(qid_col):
@@ -372,33 +387,103 @@ class CoreDataset:
         # The archive streams to the tmp file (savez keeps the exact
         # path; no .npz suffix is appended to an open handle).
         with atomic_open(path) as f:
-            np.savez_compressed(f, magic=np.asarray(BINARY_MAGIC), **arrays)
+            np.savez_compressed(f, magic=np.asarray(BINARY_MAGIC),
+                                format_version=np.asarray(
+                                    BINARY_FORMAT_VERSION),
+                                **arrays)
         Log.info("Saved binary dataset to %s", str(path))
 
     @classmethod
     def load_binary(cls, path) -> "CoreDataset":
-        z = np.load(path, allow_pickle=True)
-        if str(z["magic"]) != BINARY_MAGIC:
-            Log.fatal("Binary file %s is not a lightgbm_tpu dataset", str(path))
-        ds = cls()
-        ds.bins = z["bins"]
-        ds.used_feature_map = z["used_feature_map"]
-        ds.real_feature_idx = z["real_feature_idx"]
-        ds.num_total_features = int(z["num_total_features"])
-        ds.label_idx = int(z["label_idx"])
-        ds.feature_names = [str(x) for x in z["feature_names"]]
-        n_used = len(ds.real_feature_idx)
-        ds.bin_mappers = []
-        for i in range(n_used):
-            d = {k[len(f"mapper{i}_"):]: z[k] for k in z.files
-                 if k.startswith(f"mapper{i}_")}
-            ds.bin_mappers.append(BinMapper.from_dict(d))
-        bundle = {k[7:]: z[k] for k in z.files if k.startswith("bundle_")}
-        if bundle:
-            from .bundling import BundlePlan
-            ds.bundle_plan = BundlePlan.from_dict(bundle)
-        meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
-        ds.metadata = Metadata.from_dict(meta)
+        """Load + validate a binary dataset cache. Every failure mode a
+        truncated, bit-rotted, or foreign file can produce surfaces as
+        a BinaryDatasetError naming the file and the defect — never a
+        numpy reshape traceback (reference dataset.cpp:133-152 validates
+        its magic token + version the same way)."""
+        # probe before np.load: a text/garbage file is "never was
+        # binary" (claimed=False), not a corrupt cache
+        try:
+            with open(path, "rb") as f:
+                head = f.read(len(_ZIP_MAGIC))
+        except OSError as e:
+            raise BinaryDatasetError(f"cannot read {path}: {e}")
+        if head != _ZIP_MAGIC:
+            raise BinaryDatasetError(
+                f"{path} is not a lightgbm_tpu binary dataset (bad magic)")
+        try:
+            z = np.load(path, allow_pickle=True)
+            files = set(z.files)
+        except Exception as e:
+            raise BinaryDatasetError(
+                f"{path} is truncated or corrupt (unreadable archive: "
+                f"{e})", claimed=True)
+        if "magic" not in files:
+            raise BinaryDatasetError(
+                f"{path} is an npz archive but not a lightgbm_tpu "
+                "dataset (no magic entry)", claimed=True)
+        try:
+            if str(z["magic"]) != BINARY_MAGIC:
+                raise BinaryDatasetError(
+                    f"{path} has foreign magic {str(z['magic'])!r} "
+                    f"(expected {BINARY_MAGIC})", claimed=True)
+            version = (int(z["format_version"])
+                       if "format_version" in files else 1)
+            if version > BINARY_FORMAT_VERSION:
+                raise BinaryDatasetError(
+                    f"{path} is format version {version}; this build "
+                    f"reads up to {BINARY_FORMAT_VERSION}", claimed=True)
+            missing = [k for k in ("bins", "used_feature_map",
+                                   "real_feature_idx",
+                                   "num_total_features", "label_idx",
+                                   "feature_names", "meta_label")
+                       if k not in files]
+            if missing:
+                raise BinaryDatasetError(
+                    f"{path} is truncated (missing entries: "
+                    f"{', '.join(missing)})", claimed=True)
+            ds = cls()
+            ds.bins = z["bins"]
+            ds.used_feature_map = z["used_feature_map"]
+            ds.real_feature_idx = z["real_feature_idx"]
+            ds.num_total_features = int(z["num_total_features"])
+            ds.label_idx = int(z["label_idx"])
+            ds.feature_names = [str(x) for x in z["feature_names"]]
+            n_used = len(ds.real_feature_idx)
+            ds.bin_mappers = []
+            for i in range(n_used):
+                d = {k[len(f"mapper{i}_"):]: z[k] for k in z.files
+                     if k.startswith(f"mapper{i}_")}
+                if "num_bin" not in d:
+                    raise BinaryDatasetError(
+                        f"{path} is truncated (missing bin mapper {i} "
+                        f"of {n_used})", claimed=True)
+                ds.bin_mappers.append(BinMapper.from_dict(d))
+            bundle = {k[7:]: z[k] for k in z.files
+                      if k.startswith("bundle_")}
+            if bundle:
+                from .bundling import BundlePlan
+                ds.bundle_plan = BundlePlan.from_dict(bundle)
+            meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
+            ds.metadata = Metadata.from_dict(meta)
+        except BinaryDatasetError:
+            raise
+        except Exception as e:
+            # zip-member CRC failures surface lazily at entry access
+            raise BinaryDatasetError(
+                f"{path} is truncated or corrupt ({e})", claimed=True)
+        # length/shape cross-checks: a partially-written file whose
+        # archive still opens must not survive to a reshape traceback
+        if ds.bins.ndim != 2:
+            raise BinaryDatasetError(
+                f"{path}: bins matrix has {ds.bins.ndim} dims, "
+                "expected 2", claimed=True)
+        n_rows = int(ds.bins.shape[1])
+        n_label = int(np.asarray(z["meta_label"]).shape[0])
+        if n_label != n_rows:
+            raise BinaryDatasetError(
+                f"{path}: bin matrix holds {n_rows} rows but the label "
+                f"has {n_label} — truncated or foreign file",
+                claimed=True)
         return ds
 
 
@@ -461,7 +546,18 @@ class DatasetLoader:
                     continue
                 try:
                     ds = CoreDataset.load_binary(cand)
-                except Exception:
+                except BinaryDatasetError as e:
+                    if e.claimed and cand == str(filename):
+                        # the data file ITSELF is a (broken) binary
+                        # dataset: the text parser would only produce
+                        # garbage on it — fail with the real diagnosis
+                        Log.fatal("%s", e)
+                    if e.claimed:
+                        # rotten sibling cache: fall past it to the
+                        # text parse, like the checkpoint loader falls
+                        # past a corrupt snapshot
+                        Log.warning("ignoring unusable binary cache: %s",
+                                    e)
                     continue  # not a binary cache; fall through
                 if ds.bundle_plan is not None and (
                         not cfg.is_enable_sparse
@@ -501,6 +597,13 @@ class DatasetLoader:
                 cfg.use_two_round_loading
                 or (cfg.weight_column == "" and cfg.group_column == ""
                     and _libsvm_looks_wide(filename, cfg.has_header))):
+            if cfg.max_bad_rows > 0:
+                # the block streamer parses strictly; quarantine is an
+                # in-memory-path feature. Say so loudly instead of
+                # silently changing behavior between load routes.
+                Log.warning("max_bad_rows=%d is not applied on the "
+                            "two-round/streaming load path: malformed "
+                            "rows still abort the load", cfg.max_bad_rows)
             ds = self._load_two_round(filename, rank, num_machines)
             if ds.global_num_data is not None:
                 if cfg.is_save_binary_file:
@@ -512,7 +615,8 @@ class DatasetLoader:
             return self._apply_rank_partition(ds, rank, num_machines)
 
         label, feats, names, fmt, label_idx = parse_text_file(
-            filename, has_header=cfg.has_header, label_column=cfg.label_column)
+            filename, has_header=cfg.has_header, label_column=cfg.label_column,
+            max_bad_rows=cfg.max_bad_rows)
         weight_idx, group_idx, ignore, categorical = self._resolve_columns(
             names, feats.shape[1])
 
@@ -551,7 +655,8 @@ class DatasetLoader:
             # values -> dense fallback.
             return self._load_sparse_aligned(filename, train_ds)
         label, feats, names, fmt, _ = parse_text_file(
-            filename, has_header=cfg.has_header, label_column=cfg.label_column)
+            filename, has_header=cfg.has_header, label_column=cfg.label_column,
+            max_bad_rows=cfg.max_bad_rows)
         meta = Metadata(len(label))
         meta.set_label(label)
         weight_idx, group_idx, ignore, _ = self._resolve_columns(names, feats.shape[1])

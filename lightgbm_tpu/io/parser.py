@@ -100,7 +100,83 @@ def _parse_libsvm(path, has_header):
     return np.asarray(labels, dtype=np.float32), mat, None
 
 
-def parse_text_file(path, has_header=False, label_column=""):
+def _first_offender(path, sep, has_header, ncols):
+    """Exact (line number, description) of the first malformed line —
+    a raw-text second pass, run only when the tolerant parse already
+    found something to diagnose. DataFrame row indices cannot name the
+    line (structurally-skipped lines shift them), so re-scan the file
+    itself. Quoted fields with embedded separators can mis-split here;
+    the pass only serves the diagnostic, never the data."""
+    try:
+        from pandas._libs.parsers import STR_NA_VALUES
+        na = set(STR_NA_VALUES) | set(NA_VALUES)
+    except Exception:  # pandas internals drifted: use our own list
+        na = set(NA_VALUES) | {"", "N/A", "NULL", "None", "n/a", "<NA>"}
+    with open(path, "r") as f:
+        if has_header:
+            next(f, None)
+        for lineno, raw in enumerate(f, 2 if has_header else 1):
+            line = raw.rstrip("\r\n")
+            if not line:
+                continue  # pandas skips blank lines
+            fields = line.split(sep)
+            if len(fields) != ncols:
+                return (f"line {lineno}: wrong field count "
+                        f"({len(fields)} != {ncols}): {line!r}")
+            for col, token in enumerate(fields):
+                token = token.strip()
+                if token in na:
+                    continue
+                try:
+                    float(token)
+                except ValueError:
+                    return (f"line {lineno}: column {col} value "
+                            f"{token!r}")
+    return "not re-locatable in a raw scan (quoting?)"
+
+
+def _read_csv_quarantine(path, sep, has_header, max_bad_rows):
+    """Tolerant CSV/TSV fallback: rows with unparsable cells (and
+    structurally bad lines) are QUARANTINED — counted, diagnosed, and
+    dropped — instead of aborting the load, as long as at most
+    `max_bad_rows` rows are bad. Mirrors the LibSVM path, which already
+    skips malformed tokens per its documented rule (libsvm_pairs).
+
+    Returns (DataFrame of good rows as float64, n_quarantined). The
+    first offender is reported with its exact line number and content
+    so a producer-side bug is diagnosable from the training log alone."""
+    import pandas as pd
+
+    bad_lines = []  # structural offenders (wrong field count)
+
+    def on_bad(fields):
+        bad_lines.append("\t".join(str(f) for f in fields))
+        return None  # skip
+
+    df = pd.read_csv(path, sep=sep, header=0 if has_header else None,
+                     dtype=str, na_values=NA_VALUES, engine="python",
+                     on_bad_lines=on_bad)
+    numeric = df.apply(pd.to_numeric, errors="coerce")
+    # a bad CELL coerced to NaN where the raw text was neither empty
+    # nor a recognized NA marker (those legitimately parse to NaN and
+    # become 0.0 downstream, same as the strict path)
+    bad_cells = numeric.isna().to_numpy() & ~df.isna().to_numpy()
+    bad_rows = bad_cells.any(axis=1)
+    n_bad = int(bad_rows.sum()) + len(bad_lines)
+    if n_bad:
+        first = _first_offender(path, sep, has_header, df.shape[1])
+        if n_bad > max_bad_rows:
+            Log.fatal("%d malformed rows in %s exceed max_bad_rows=%d; "
+                      "first offender: %s", n_bad, str(path),
+                      max_bad_rows, first)
+        Log.warning("quarantined %d malformed row(s) in %s "
+                    "(max_bad_rows=%d); first offender: %s",
+                    n_bad, str(path), max_bad_rows, first)
+    return numeric[~bad_rows], n_bad
+
+
+def parse_text_file(path, has_header=False, label_column="",
+                    max_bad_rows=0):
     """Parse a data file into
     (label, features (N, C-1) float32, header names, format, label_idx).
 
@@ -108,6 +184,10 @@ def parse_text_file(path, has_header=False, label_column=""):
     (`DatasetLoader::SetHeader`, dataset_loader.cpp:57-160): label defaults
     to column 0; `name:xxx` selects by header name; plain integers are
     file-column indices. Feature indices do NOT count the label column.
+
+    max_bad_rows > 0 tolerates up to that many malformed CSV/TSV rows
+    (quarantined with diagnostics, _read_csv_quarantine); the default 0
+    keeps strict mode — the first malformed row aborts the load.
     """
     import pandas as pd
 
@@ -117,8 +197,11 @@ def parse_text_file(path, has_header=False, label_column=""):
         return label, mat, names, fmt, 0
 
     sep = "," if fmt == "csv" else "\t"
-    df = pd.read_csv(path, sep=sep, header=0 if has_header else None,
-                     dtype=np.float64, na_values=NA_VALUES)
+    if max_bad_rows > 0:
+        df, _ = _read_csv_quarantine(path, sep, has_header, max_bad_rows)
+    else:
+        df = pd.read_csv(path, sep=sep, header=0 if has_header else None,
+                         dtype=np.float64, na_values=NA_VALUES)
     names = [str(c) for c in df.columns] if has_header else None
     data = df.to_numpy(dtype=np.float64)
     data = np.nan_to_num(data, nan=0.0)

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..parallel import heartbeat
 from ..utils import common, faults, guardrails
 from ..utils.log import Log
 from ..utils.timers import TIMERS
@@ -423,6 +424,9 @@ class GBDT:
     def train_one_iter(self, gradients=None, hessians=None, is_eval=True):
         """gbdt.cpp:210-245. Returns True if training should stop."""
         faults.crash_if_reached(self.iter)
+        faults.rank_crash_if_reached(self.iter)
+        faults.rank_hang_if_reached(self.iter)
+        heartbeat.WATCHDOG.set_iteration(self.iter)
         if gradients is None or hessians is None:
             if self.objective is None:
                 Log.fatal("No object function provided")
@@ -475,7 +479,8 @@ class GBDT:
                     else:
                         updater.add_score_by_device_tree(
                             out, self.shrinkage_rate, k)
-            with TIMERS.phase("host_sync"):
+            with TIMERS.phase("host_sync"), \
+                    heartbeat.collective_guard("leaf_count_sync"):
                 stopped = tree.num_leaves <= 1  # scalar sync: the only wait
             if stopped:
                 Log.info("Stopped training because there are no more leafs "
@@ -667,6 +672,9 @@ class GBDT:
         # inside it loses the whole block, which is exactly what
         # crashing at its launch models (utils/faults.py)
         faults.crash_if_reached(self.iter, num_iters)
+        faults.rank_crash_if_reached(self.iter, num_iters)
+        faults.rank_hang_if_reached(self.iter, num_iters)
+        heartbeat.WATCHDOG.set_iteration(self.iter)
         fn = self._get_fused_fn(num_iters)
         learner = self.tree_learner
         # same RNG stream and consumption order as the sequential path:
@@ -675,16 +683,21 @@ class GBDT:
             [[learner._sample_features() for _ in range(self.num_class)]
              for _ in range(num_iters)]))
         iters = jnp.arange(self.iter, self.iter + num_iters, dtype=jnp.int32)
-        final_score, stacked = fn(self.train_score_updater.score, fmasks,
-                                  iters)
-        self.train_score_updater.score = final_score
-        policy = getattr(self.config, "nonfinite_guard", "raise")
-        if policy != "off":
-            # in-graph iterations cannot be guarded individually; the
-            # block boundary is where divergence becomes detectable
-            guardrails.guard_scores(np.asarray(final_score),
-                                    self.iter + num_iters, policy)
-        host = jax.device_get(stacked)  # ONE transfer for the whole block
+        # the whole block is one device program; its host-side waits
+        # (score pull, stacked-tree transfer) are THE block-boundary
+        # sync points the collective watchdog brackets
+        with heartbeat.collective_guard("fused_block"):
+            final_score, stacked = fn(self.train_score_updater.score,
+                                      fmasks, iters)
+            self.train_score_updater.score = final_score
+            policy = getattr(self.config, "nonfinite_guard", "raise")
+            if policy != "off":
+                # in-graph iterations cannot be guarded individually;
+                # the block boundary is where divergence becomes
+                # detectable
+                guardrails.guard_scores(np.asarray(final_score),
+                                        self.iter + num_iters, policy)
+            host = jax.device_get(stacked)  # ONE transfer for the block
         nsp = np.asarray(host["n_splits"]).reshape(num_iters, -1)  # (T, K)
         empty = (nsp == 0).any(axis=1)
         t_eff = int(np.argmax(empty)) if bool(empty.any()) else num_iters
@@ -1302,20 +1315,71 @@ class GBDT:
             regs["drop_sampler"] = self._random_for_drop
         return regs
 
+    def _multihost_row_sharded(self):
+        """True when training rows are partitioned across processes —
+        the layout under which each rank's train score covers only its
+        local block (parallel/learners.py)."""
+        learner = self.tree_learner
+        return (learner is not None
+                and getattr(learner, "n_proc", 1) > 1
+                and getattr(learner, "shard_rows", False))
+
+    def _allgather_row_counts(self):
+        """(P,) local-row counts in rank order. COLLECTIVE: every
+        process must call this at the same point (watchdog-armed — a
+        peer wedged at a snapshot point must not hang the others
+        forever)."""
+        from jax.experimental import multihost_utils
+        n_local = int(np.asarray(self.train_score_updater.score).shape[-1])
+        with heartbeat.collective_guard("snapshot_counts_gather"):
+            return np.asarray(multihost_utils.process_allgather(
+                np.asarray([n_local], dtype=np.int64))).reshape(-1)
+
+    def _gather_global_train_score(self):
+        """Assemble the GLOBAL (num_class, N) train score from every
+        rank's local block (ranks hold contiguous row ranges in rank
+        order, parallel/distributed.py partition_rows). COLLECTIVE —
+        which is why multi-host snapshots require every rank to call
+        capture_training_state at the cadence point even though only
+        rank 0 writes the file (application.py train): a rank-local
+        snapshot would be useless to a restart whose surviving ranks
+        re-partition the rows (the shrunken-world resume path)."""
+        from jax.experimental import multihost_utils
+        local = np.asarray(self.train_score_updater.score,
+                           dtype=np.float32)            # (K, n_local)
+        counts = self._allgather_row_counts()
+        n_max = int(counts.max())
+        padded = np.zeros((local.shape[0], n_max), dtype=np.float32)
+        padded[:, :local.shape[1]] = local
+        with heartbeat.collective_guard("snapshot_score_gather"):
+            blocks = np.asarray(multihost_utils.process_allgather(padded))
+        return np.concatenate(
+            [blocks[r][:, :int(counts[r])] for r in range(len(counts))],
+            axis=1)
+
     def capture_training_state(self):
         """Full mid-training state for utils/checkpoint.py: everything
         `restore_training_state` needs to continue training on the SAME
         config + dataset and produce the bit-identical model string of
         an uninterrupted run. Score arrays are saved verbatim (float32
         bits) — recomputing them from trees would change summation
-        order and diverge the histogram sums."""
+        order and diverge the histogram sums. Multi-host row-sharded
+        training stores the allgathered GLOBAL score with a layout tag,
+        so a restart can re-slice it for any surviving topology."""
+        if self._multihost_row_sharded():
+            train_score = self._gather_global_train_score()
+            score_layout = "global_rows"
+        else:
+            train_score = np.asarray(self.train_score_updater.score)
+            score_layout = "local"
         state = {
             "state_version": 1,
             "model_str": self.save_model_to_string(-1),
             "iter": int(self.iter),
             "num_init_iteration": int(self.num_init_iteration),
             "num_class": int(self.num_class),
-            "train_score": np.asarray(self.train_score_updater.score),
+            "train_score": train_score,
+            "train_score_layout": score_layout,
             "valid_scores": [np.asarray(u.score)
                              for u in self.valid_score_updaters],
             "best_iter": [list(map(int, x)) for x in self.best_iter],
@@ -1383,6 +1447,22 @@ class GBDT:
         self.num_iteration_for_pred = 0
         self.iter = int(state["iter"])
         train_score = np.asarray(state["train_score"], dtype=np.float32)
+        if (state.get("train_score_layout") == "global_rows"
+                and self._multihost_row_sharded()):
+            # global capture -> this topology's local block: contiguous
+            # rank-order slices, valid for the ORIGINAL topology and for
+            # a shrunken world that re-partitioned the rows. (On a
+            # single process the global score IS the local score and
+            # the plain shape check below covers it.)
+            counts = self._allgather_row_counts()
+            if int(counts.sum()) != train_score.shape[-1]:
+                Log.fatal("Checkpoint global train score has %d rows "
+                          "but the current topology holds %d "
+                          "(different training data?)",
+                          train_score.shape[-1], int(counts.sum()))
+            rank = jax.process_index()
+            offset = int(counts[:rank].sum())
+            train_score = train_score[:, offset:offset + int(counts[rank])]
         if train_score.shape != tuple(self.train_score_updater.score.shape):
             Log.fatal("Checkpoint train-score shape %s does not match "
                       "dataset shape %s (different training data?)",

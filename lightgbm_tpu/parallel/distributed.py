@@ -19,7 +19,6 @@ overrides (needed e.g. for multiple ranks on one host).
 """
 
 import os
-import socket
 import time
 
 import jax
@@ -27,82 +26,14 @@ import numpy as np
 
 from ..utils import faults
 from ..utils.log import Log
+# machine-list parsing + rank discovery live in the jax-free
+# parallel/machines.py (the supervisor process reads machine lists
+# without importing jax); re-exported here for existing import paths
+from .machines import (_local_addresses, _split_host_port,  # noqa: F401
+                       find_local_rank, format_machine_list,
+                       parse_machine_list)
 
 _initialized = False
-
-
-def _split_host_port(token, lineno):
-    """One `host:port` token -> (host, port_str), IPv6-safe: bracketed
-    `[addr]:port` is the canonical v6 form; a bare single-colon token is
-    `host:port`; multiple colons without brackets is an IPv6 address
-    with no parseable port — a hard error, not a silent mangle."""
-    if token.startswith("["):
-        host, bracket, port = token.partition("]")
-        if not bracket or not port.startswith(":") or not port[1:]:
-            Log.fatal("Machine list file parse error at line %d: %r "
-                      "(bracketed IPv6 must be '[addr]:port')",
-                      lineno, token)
-        return host[1:], port[1:]
-    if token.count(":") == 1:
-        host, _, port = token.partition(":")
-        return host, port
-    Log.fatal("Machine list file parse error at line %d: %r (IPv6 "
-              "addresses need '[addr]:port' or 'addr port')",
-              lineno, token)
-
-
-def parse_machine_list(path):
-    """`ip port` (or `ip:port`) lines -> [(ip, port)]
-    (linkers_socket.cpp:36-56). `#` starts a comment; IPv6 addresses
-    use `[addr]:port` or `addr port`; repeated entries are deduped
-    (keeping first occurrence — duplicate lines in hand-edited lists
-    must not inflate the rank count)."""
-    machines = []
-    seen = set()
-    with open(path) as f:
-        for lineno, raw in enumerate(f, 1):
-            line = raw.split("#", 1)[0].strip()
-            if not line:
-                continue
-            parts = line.split()
-            if len(parts) >= 2:
-                host, port = parts[0], parts[1]
-            else:
-                host, port = _split_host_port(parts[0], lineno)
-            if host.startswith("[") and host.endswith("]"):
-                host = host[1:-1]
-            try:
-                port = int(port)
-            except ValueError:
-                Log.fatal("Machine list file parse error at line %d: "
-                          "port %r is not an integer", lineno, port)
-            if (host, port) in seen:
-                Log.warning("machine list line %d duplicates %s:%d; "
-                            "ignoring", lineno, host, port)
-                continue
-            seen.add((host, port))
-            machines.append((host, port))
-    return machines
-
-
-def _local_addresses():
-    names = {"localhost", "127.0.0.1", socket.gethostname()}
-    try:
-        host, aliases, ips = socket.gethostbyname_ex(socket.gethostname())
-        names.update([host] + aliases + ips)
-    except OSError:
-        pass
-    return names
-
-
-def find_local_rank(machines):
-    """linkers_socket.cpp:58-86: my rank is the first machine-list entry
-    matching a local address."""
-    local = _local_addresses()
-    for i, (ip, _) in enumerate(machines):
-        if ip in local:
-            return i
-    Log.fatal("Machine list file doesn't contain the local machine")
 
 
 def _call_initialize(coordinator, num_processes, rank, timeout_s):
@@ -201,7 +132,18 @@ def init_from_config(config):
                   "LIGHTGBM_TPU_RANK against the machine list",
                   rank, config.num_machines, config.machine_list_file,
                   len(machines))
+    faults.set_rank(rank)  # rank-targeted fault injection + heartbeats
     coordinator = f"{machines[0][0]}:{machines[0][1]}"
+    # CPU multi-process collectives need an explicit implementation
+    # (the default CPU client refuses cross-process computations with
+    # "Multiprocess computations aren't implemented"); gloo ships with
+    # this jax and is what the 2-process CPU test harness runs on. A
+    # TPU backend ignores the knob; absent knob (API drift) means CPU
+    # multi-host was unsupported anyway, so best-effort is correct.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     # NOTE: must run before anything initializes the XLA backend —
     # do not touch jax.devices()/process_count() above this line
     if not _initialize_with_retry(coordinator, config.num_machines, rank,

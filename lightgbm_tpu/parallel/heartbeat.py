@@ -1,0 +1,394 @@
+"""Distributed-training supervisor primitives: heartbeats + watchdog.
+
+No reference equivalent: the reference's multi-machine story assumes
+every socket peer stays alive for the whole job (linkers_socket.cpp
+blocks forever in recv). Here worker loss is routine — TPU pods get
+preempted, hosts straggle — so each rank both *proves* its own liveness
+and *bounds* how long it will wait on peers:
+
+- **Heartbeats**: every rank publishes a monotonic beat (seq,
+  iteration, wall time, last sync timing) as one small JSON file in a
+  SHARED directory (the snapshot dir — file-based so no new network
+  dependency; TPU fleets already mount shared storage for snapshots).
+  A daemon monitor thread on every rank re-publishes and scans peers:
+  a peer whose beat has not changed for `heartbeat_timeout_s` of
+  *observer-local* monotonic time is declared dead — wall-clock skew
+  between hosts cannot mis-declare, because staleness is measured from
+  when THIS process last saw the file change.
+
+- **Collective watchdog**: `jax.lax` collectives have no timeout — a
+  dead or hung peer blocks every survivor forever inside the runtime.
+  The watchdog is a host-side timer armed around each blocking
+  device-sync point (parallel/learners.py, models/gbdt.py); on expiry
+  it logs WHICH rank/iteration/collective hung, drops a marker file
+  for the supervisor, and aborts with a distinct exit code
+  (EXIT_WATCHDOG) instead of hanging. The armed sections double as the
+  per-iteration straggler probe: each rank publishes its last sync
+  duration and the monitor logs the slowest-rank delta.
+
+Both pieces are jax-free so the supervisor process and the CPU test
+harness can import them without touching the accelerator runtime. The
+elastic-restart loop that consumes the exit codes lives in
+lightgbm_tpu/supervisor.py.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+from ..utils import faults
+from ..utils.log import Log
+
+# Distinct restartable exit codes (the supervisor keys off these; both
+# differ from faults.HARD_CRASH_EXIT_CODE=43 so logs/tests can tell an
+# injected kill from a detected failure).
+EXIT_WATCHDOG = 117    # this rank gave up waiting inside a collective
+EXIT_PEER_LOST = 118   # this rank saw a peer's heartbeat go stale
+
+HEARTBEAT_SUBDIR = "heartbeats"
+
+
+def heartbeat_dir(shared_dir):
+    return os.path.join(os.fspath(shared_dir), HEARTBEAT_SUBDIR)
+
+
+def heartbeat_path(directory, rank):
+    return os.path.join(os.fspath(directory), f"hb.rank{int(rank):04d}.json")
+
+
+def watchdog_marker_path(directory, rank):
+    return os.path.join(os.fspath(directory),
+                        f"watchdog.rank{int(rank):04d}.json")
+
+
+def atomic_write_json(path, payload):
+    """Small-file atomic publish (tmp + os.replace, no fsync: losing a
+    beat to a crash is harmless, a torn concurrent read is not)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        Log.warning("heartbeat write failed (%s): %s", path, e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def read_heartbeat(path):
+    """Parse one heartbeat file; None when missing/torn/foreign."""
+    try:
+        with open(path) as f:
+            beat = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return beat if isinstance(beat, dict) and "seq" in beat else None
+
+
+class CollectiveWatchdog:
+    """Host-side timer bracketing blocking device-sync points.
+
+    `armed(name)` starts a daemon timer before the sync and cancels it
+    after; if the sync outlives `timeout_s` the expiry handler logs the
+    (rank, iteration, collective) triple, writes a marker file into the
+    shared directory, and `os._exit(EXIT_WATCHDOG)` — a hung XLA
+    collective cannot be interrupted from Python, so aborting the
+    process is the only way to return control to the supervisor.
+    `timeout_s` must exceed the worst-case legitimate sync (including a
+    cold compile on the first iteration); 0 disables.
+
+    Armed sections also record their elapsed time (`timings`,
+    `last_sync_s`) — the straggler signal the heartbeat publisher
+    ships to peers.
+    """
+
+    def __init__(self, timeout_s=0.0, rank=0, on_expire=None,
+                 marker_dir=None):
+        self.timeout_s = float(timeout_s)
+        self.rank = int(rank)
+        self.iteration = -1
+        self.on_expire = on_expire  # tests inject; None = log+marker+exit
+        self.marker_dir = marker_dir
+        self.timings = {}           # collective name -> last elapsed s
+        self.last_sync_s = 0.0
+
+    def set_iteration(self, iteration):
+        self.iteration = int(iteration)
+
+    def _expire(self, name, iteration):
+        Log.warning(
+            "collective watchdog expired: rank %d hung in %r at "
+            "iteration %d for more than %.1fs — a peer is dead or "
+            "stalled; aborting with exit code %d",
+            self.rank, name, iteration, self.timeout_s, EXIT_WATCHDOG)
+        if self.marker_dir:
+            atomic_write_json(
+                watchdog_marker_path(self.marker_dir, self.rank),
+                {"rank": self.rank, "collective": name,
+                 "iteration": iteration, "timeout_s": self.timeout_s,
+                 "time": time.time()})
+        if self.on_expire is not None:
+            self.on_expire(name, iteration)
+            return
+        os._exit(EXIT_WATCHDOG)
+
+    @contextlib.contextmanager
+    def armed(self, name):
+        if self.timeout_s <= 0:
+            yield
+            return
+        timer = threading.Timer(self.timeout_s, self._expire,
+                                (name, self.iteration))
+        timer.daemon = True
+        start = time.monotonic()
+        timer.start()
+        try:
+            yield
+        finally:
+            timer.cancel()
+            elapsed = time.monotonic() - start
+            self.timings[name] = elapsed
+            self.last_sync_s = elapsed
+
+
+class HeartbeatService:
+    """Per-rank heartbeat publisher + peer monitor (one daemon thread).
+
+    Publishes this rank's beat every `interval_s` (default timeout/4)
+    and scans peers; `dead_peers()` lists ranks whose beat has not
+    advanced for `timeout_s` of local monotonic time. A rank that never
+    publishes at all (crashed before its first write, or a stale dir
+    from a previous incarnation) gets one full timeout of grace from
+    monitor start. On detection the monitor calls `on_peer_lost(ranks)`
+    once — default: log + `os._exit(EXIT_PEER_LOST)`, returning control
+    to the supervisor while the main thread may still be blocked inside
+    a collective.
+    """
+
+    def __init__(self, directory, rank, num_ranks, timeout_s,
+                 interval_s=None, iteration_fn=None, watchdog=None,
+                 on_peer_lost=None):
+        self.directory = os.fspath(directory)
+        self.rank = int(rank)
+        self.num_ranks = int(num_ranks)
+        self.timeout_s = float(timeout_s)
+        self.interval_s = (float(interval_s) if interval_s
+                           else max(self.timeout_s / 4.0, 0.05))
+        self.iteration_fn = iteration_fn      # () -> current iteration
+        self.watchdog = watchdog              # straggler timing source
+        self.on_peer_lost = on_peer_lost      # tests inject
+        self.last_snapshot = None             # (iteration, path) via notify
+        self._seq = 0
+        self._peers = {}   # rank -> [last_seq_or_None, last_change_mono, done]
+        self._started = None
+        self._stop = threading.Event()
+        self._thread = None
+        self._fired = False
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------ publish
+    def publish(self, done=False):
+        """Write this rank's beat (skipped under the `heartbeat_stale`
+        fault — the process stays alive but looks dead to peers)."""
+        if faults.heartbeat_suppressed(self.rank):
+            return
+        self._seq += 1
+        iteration = -1
+        if self.iteration_fn is not None:
+            try:
+                iteration = int(self.iteration_fn())
+            except Exception:   # a mid-teardown booster must not kill the beat
+                iteration = -1
+        beat = {"rank": self.rank, "seq": self._seq, "pid": os.getpid(),
+                "iteration": iteration, "time": time.time(),
+                "sync_s": round(getattr(self.watchdog, "last_sync_s", 0.0)
+                                or 0.0, 6)}
+        if done:
+            beat["done"] = True
+        if self.last_snapshot is not None:
+            beat["snapshot_iteration"] = int(self.last_snapshot[0])
+        atomic_write_json(heartbeat_path(self.directory, self.rank), beat)
+
+    def notify_snapshot(self, iteration, path):
+        """Record the newest saved snapshot so the published beats say
+        where a restart would resume from (callback._Checkpoint calls
+        this through `notify_checkpoint` below)."""
+        self.last_snapshot = (int(iteration), os.fspath(path))
+
+    # -------------------------------------------------------------- scan
+    def scan(self):
+        """Refresh peer freshness state. Returns {rank: beat-or-None}."""
+        now = time.monotonic()
+        if self._started is None:
+            self._started = now
+        beats = {}
+        for rank in range(self.num_ranks):
+            if rank == self.rank:
+                continue
+            beat = read_heartbeat(heartbeat_path(self.directory, rank))
+            beats[rank] = beat
+            state = self._peers.get(rank)
+            if state is None:
+                # first sight (or still missing): full grace from start
+                state = self._peers[rank] = [None, self._started, False]
+            if beat is not None:
+                key = (beat.get("pid"), beat["seq"])
+                if key != state[0]:
+                    state[0] = key
+                    state[1] = now
+                state[2] = bool(beat.get("done"))
+        return beats
+
+    def peer_ages(self):
+        """{rank: seconds since this process last saw the beat change}."""
+        now = time.monotonic()
+        return {rank: now - state[1] for rank, state in self._peers.items()}
+
+    def dead_peers(self):
+        """Ranks stale past `timeout_s` (completed ranks never count)."""
+        return sorted(rank for rank, age in self.peer_ages().items()
+                      if age > self.timeout_s and not self._peers[rank][2])
+
+    def straggler_report(self, beats):
+        """Slowest-rank delta of the last published sync timings, e.g.
+        'rank 1 slowest (+2.31s sync delta at iteration 7)'; None when
+        fewer than two live timings exist."""
+        timings = {self.rank: getattr(self.watchdog, "last_sync_s", 0.0)
+                   or 0.0}
+        iteration = -1
+        for rank, beat in beats.items():
+            if beat is not None and not beat.get("done"):
+                timings[rank] = float(beat.get("sync_s", 0.0))
+                iteration = max(iteration, int(beat.get("iteration", -1)))
+        if len(timings) < 2:
+            return None
+        slowest = max(timings, key=timings.get)
+        delta = timings[slowest] - min(timings.values())
+        return (f"rank {slowest} slowest (+{delta:.2f}s sync delta at "
+                f"iteration {iteration})")
+
+    # ------------------------------------------------------------ thread
+    def check_once(self):
+        """One publish+scan cycle; fires on_peer_lost on new deaths."""
+        self.publish()
+        beats = self.scan()
+        report = self.straggler_report(beats)
+        if report:
+            Log.debug("heartbeat monitor: %s", report)
+        dead = self.dead_peers()
+        if dead and not self._fired:
+            self._fired = True
+            ages = self.peer_ages()
+            Log.warning(
+                "heartbeat monitor: rank(s) %s declared dead — no "
+                "heartbeat for %s (timeout %.1fs); last straggler "
+                "state: %s",
+                dead, ", ".join(f"{ages[r]:.1f}s" for r in dead),
+                self.timeout_s, report or "n/a")
+            if self.on_peer_lost is not None:
+                self.on_peer_lost(dead)
+            else:
+                Log.warning("aborting with exit code %d so the "
+                            "supervisor can restart from the newest "
+                            "shared snapshot", EXIT_PEER_LOST)
+                os._exit(EXIT_PEER_LOST)
+        return dead
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check_once()
+            except Exception as e:  # monitor must never kill training
+                Log.warning("heartbeat monitor error: %s", e)
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._started = time.monotonic()
+        self.publish()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="lgbm-tpu-heartbeat")
+        self._thread.start()
+        return self
+
+    def stop(self, done=True):
+        """Stop the monitor; a final `done` beat tells peers this rank
+        finished cleanly (a finished rank must never look dead)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(2 * self.interval_s, 1.0))
+            self._thread = None
+        if done:
+            self.publish(done=True)
+
+
+# ---------------------------------------------------------- module state
+#
+# One watchdog + at most one heartbeat service per process, configured
+# by the CLI (application.py) or an embedder. The singleton WATCHDOG is
+# mutated in place so call sites can bind `collective_guard` once.
+
+WATCHDOG = CollectiveWatchdog(0.0)
+_SERVICE = None
+
+
+def collective_guard(name):
+    """Context manager arming the process watchdog around one blocking
+    device-sync point; no-op until `configure` enables it."""
+    return WATCHDOG.armed(name)
+
+
+def service():
+    return _SERVICE
+
+
+def configure(config, shared_dir, rank, num_ranks, iteration_fn=None):
+    """Enable the supervisor primitives from config knobs:
+    `collective_timeout_s` arms the watchdog, `heartbeat_timeout_s` (>0,
+    multi-rank, with a shared dir) starts the heartbeat service.
+    Returns the service (or None). Idempotent per process."""
+    global _SERVICE
+    WATCHDOG.timeout_s = float(getattr(config, "collective_timeout_s", 0.0)
+                               or 0.0)
+    WATCHDOG.rank = int(rank)
+    timeout = float(getattr(config, "heartbeat_timeout_s", 0.0) or 0.0)
+    if shared_dir:
+        WATCHDOG.marker_dir = heartbeat_dir(shared_dir)
+        if WATCHDOG.timeout_s > 0:
+            os.makedirs(WATCHDOG.marker_dir, exist_ok=True)
+    if _SERVICE is not None:
+        return _SERVICE
+    if timeout > 0 and num_ranks > 1 and shared_dir:
+        _SERVICE = HeartbeatService(
+            heartbeat_dir(shared_dir), rank, num_ranks, timeout,
+            iteration_fn=iteration_fn, watchdog=WATCHDOG).start()
+        Log.info("heartbeat service: rank %d of %d publishing to %s "
+                 "every %.2fs (peer timeout %.1fs)", rank, num_ranks,
+                 _SERVICE.directory, _SERVICE.interval_s, timeout)
+    return _SERVICE
+
+
+def bind_iteration_source(fn):
+    """Late-bind the iteration provider (engine.train knows the booster
+    only after the service may already be running)."""
+    if _SERVICE is not None and fn is not None:
+        _SERVICE.iteration_fn = fn
+
+
+def notify_checkpoint(iteration, path):
+    """Record a freshly saved snapshot in the published beats."""
+    if _SERVICE is not None:
+        _SERVICE.notify_snapshot(iteration, path)
+
+
+def shutdown(done=True):
+    """Stop the service and disarm the watchdog (normal end of a run)."""
+    global _SERVICE
+    if _SERVICE is not None:
+        _SERVICE.stop(done=done)
+        _SERVICE = None
+    WATCHDOG.timeout_s = 0.0

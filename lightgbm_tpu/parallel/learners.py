@@ -45,6 +45,7 @@ from ..models.tree_learner import SerialTreeLearner, build_tree_device
 from ..ops.split import (K_MIN_SCORE, find_best_split, per_feature_best,
                          split_info_at)
 from ..utils.log import Log
+from .heartbeat import collective_guard
 
 AXIS = "data"
 
@@ -258,21 +259,37 @@ class _MeshedTreeLearner(SerialTreeLearner):
             return place_replicated(NamedSharding(self.mesh, P()), arr)
         return jnp.asarray(arr)
 
+    # The watchdog-armed device-sync points. `train_device` launches
+    # the builder whose collectives block until every peer arrives —
+    # with jax's async dispatch the WAIT can surface at launch, at the
+    # row-leaf host gather, or at the leaf-value fetch, so all three
+    # are bracketed; whichever one a dead/straggling peer wedges, the
+    # watchdog names it and aborts instead of hanging forever
+    # (parallel/heartbeat.py; armed only when `collective_timeout_s`
+    # is set, zero overhead otherwise).
+    def train_device(self, grad, hess, inbag=None):
+        with collective_guard(f"{self.name}:tree_build"):
+            return super().train_device(grad, hess, inbag)
+
     def local_row_leaf(self, out, n_local):
         """This process's slice of the global row->leaf partition (for
         the local score updater)."""
         if self.n_proc == 1 or not self.shard_rows:
             return out["row_leaf"][:n_local]
-        shards = sorted(out["row_leaf"].addressable_shards,
-                        key=lambda s: s.index[0].start)
-        # shards are committed to distinct local devices; assemble on host
-        return np.concatenate([np.asarray(s.data) for s in shards])[:n_local]
+        with collective_guard(f"{self.name}:row_leaf_gather"):
+            shards = sorted(out["row_leaf"].addressable_shards,
+                            key=lambda s: s.index[0].start)
+            # shards are committed to distinct local devices; assemble
+            # on host
+            return np.concatenate(
+                [np.asarray(s.data) for s in shards])[:n_local]
 
     def local_leaf_values(self, out):
         """Fully-replicated global -> local array (multi-host)."""
         if self.n_proc == 1:
             return out["leaf_value"]
-        return jnp.asarray(jax.device_get(out["leaf_value"]))
+        with collective_guard(f"{self.name}:leaf_value_fetch"):
+            return jnp.asarray(jax.device_get(out["leaf_value"]))
 
     def _out_specs(self):
         specs = {k: P() for k in _TREE_OUT_KEYS}

@@ -1,0 +1,101 @@
+"""Machine-list parsing + rank discovery (jax-free).
+
+Reference: src/network/linkers_socket.cpp:20-86 (machine-list parsing
+and rank discovery). Split out of parallel/distributed.py so the
+elastic-restart supervisor (lightgbm_tpu/supervisor.py) — which
+launches and babysits the training processes but must never touch the
+accelerator runtime itself — can read and rewrite machine lists
+without importing jax. distributed.py re-exports everything here, so
+existing import paths keep working.
+"""
+
+import socket
+
+from ..utils.log import Log
+
+
+def _split_host_port(token, lineno):
+    """One `host:port` token -> (host, port_str), IPv6-safe: bracketed
+    `[addr]:port` is the canonical v6 form; a bare single-colon token is
+    `host:port`; multiple colons without brackets is an IPv6 address
+    with no parseable port — a hard error, not a silent mangle."""
+    if token.startswith("["):
+        host, bracket, port = token.partition("]")
+        if not bracket or not port.startswith(":") or not port[1:]:
+            Log.fatal("Machine list file parse error at line %d: %r "
+                      "(bracketed IPv6 must be '[addr]:port')",
+                      lineno, token)
+        return host[1:], port[1:]
+    if token.count(":") == 1:
+        host, _, port = token.partition(":")
+        return host, port
+    Log.fatal("Machine list file parse error at line %d: %r (IPv6 "
+              "addresses need '[addr]:port' or 'addr port')",
+              lineno, token)
+
+
+def parse_machine_list(path):
+    """`ip port` (or `ip:port`) lines -> [(ip, port)]
+    (linkers_socket.cpp:36-56). `#` starts a comment; IPv6 addresses
+    use `[addr]:port` or `addr port`. A repeated host:port pair is a
+    hard error: two ranks cannot share one port, so a duplicate line in
+    a hand-edited list either silently shrinks the rank count (deduped)
+    or hangs the job in the coordinator handshake (kept) — both worse
+    than failing here with the line number."""
+    machines = []
+    seen = {}
+    with open(path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) >= 2:
+                host, port = parts[0], parts[1]
+            else:
+                host, port = _split_host_port(parts[0], lineno)
+            if host.startswith("[") and host.endswith("]"):
+                host = host[1:-1]
+            try:
+                port = int(port)
+            except ValueError:
+                Log.fatal("Machine list file parse error at line %d: "
+                          "port %r is not an integer", lineno, port)
+            if (host, port) in seen:
+                Log.fatal("Machine list file line %d duplicates %s:%d "
+                          "(first at line %d): every rank needs its own "
+                          "host:port", lineno, host, port,
+                          seen[(host, port)])
+            seen[(host, port)] = lineno
+            machines.append((host, port))
+    return machines
+
+
+def format_machine_list(machines):
+    """[(host, port)] -> machine-list file text (IPv6 hosts bracketed
+    so the round-trip through parse_machine_list is exact)."""
+    lines = []
+    for host, port in machines:
+        text = f"[{host}]:{port}" if ":" in host else f"{host} {port}"
+        lines.append(text)
+    return "\n".join(lines) + "\n"
+
+
+def _local_addresses():
+    names = {"localhost", "127.0.0.1", socket.gethostname()}
+    try:
+        host, aliases, ips = socket.gethostbyname_ex(socket.gethostname())
+        names.update([host] + aliases + ips)
+    except OSError:
+        pass
+    return names
+
+
+def find_local_rank(machines):
+    """linkers_socket.cpp:58-86: my rank is the first machine-list entry
+    matching a local address."""
+    local = _local_addresses()
+    for i, (ip, _) in enumerate(machines):
+        if ip in local:
+            return i
+    Log.fatal("Machine list file doesn't contain the local machine")

@@ -32,9 +32,29 @@ Known fault names (value semantics in parentheses):
 - ``hard_crash`` (flag): escalate `crash_at_iteration` from a Python
   exception to `os._exit(43)` — a true no-cleanup kill, the closest
   in-process analog of a TPU preemption.
+
+Distributed-supervisor faults (rank-targeted; the value is
+``"rank:iteration"``, or a bare iteration to hit every rank). These
+fire only on the FIRST launch of a supervised job: the supervisor
+(lightgbm_tpu/supervisor.py) stamps LIGHTGBM_TPU_RESTART_ATTEMPT on
+relaunches, so a restarted worker trains through — the injection
+models one preemption/straggler event, not a permanently broken rank.
+
+- ``rank_crash_at_iteration`` (``rank:iter``): `os._exit(43)` the
+  matching rank just before boosting iteration k — a dead peer; the
+  survivors' heartbeat monitor must detect it within
+  `heartbeat_timeout_s` (parallel/heartbeat.py).
+- ``rank_hang_at_iteration`` (``rank:iter``): the matching rank sleeps
+  forever just before iteration k — a straggler/hang; the PEERS block
+  in the next collective until their watchdog (`collective_timeout_s`)
+  fires.
+- ``heartbeat_stale`` (rank index; -1 = every rank): the matching
+  rank's heartbeat publisher stops writing while training continues —
+  models a wedged monitor/filesystem so peers declare it dead.
 """
 
 import os
+import time
 
 ENV_VAR = "LIGHTGBM_TPU_FAULTS"
 
@@ -118,6 +138,52 @@ class injected_faults:
         return False
 
 
+# --------------------------------------------------------- rank targeting
+
+_rank = None
+
+
+def set_rank(rank):
+    """Record this process's distributed rank for rank-targeted faults
+    (called by parallel/distributed.py init and the supervisor's env)."""
+    global _rank
+    _rank = int(rank)
+
+
+def current_rank():
+    if _rank is not None:
+        return _rank
+    try:
+        return int(os.environ.get("LIGHTGBM_TPU_RANK", "0"))
+    except ValueError:
+        return 0
+
+
+def _rank_iter_spec(name):
+    """Parse a rank-targeted iteration fault value: ``"rank:iter"``
+    targets one rank, a bare integer targets every rank. Returns
+    (rank_or_None, iteration) or None when unarmed/unparsable."""
+    value = _active.get(name)
+    if value is None:
+        return None
+    if isinstance(value, int):
+        return None, value
+    text = str(value)
+    rank_s, sep, iter_s = text.partition(":")
+    if not sep:
+        return None
+    try:
+        return int(rank_s), int(iter_s)
+    except ValueError:
+        return None
+
+
+def _is_restarted_attempt():
+    """True inside a supervisor relaunch (attempt > 0): one-shot rank
+    faults must not re-fire after the restart they exist to provoke."""
+    return os.environ.get("LIGHTGBM_TPU_RESTART_ATTEMPT", "0") not in ("", "0")
+
+
 # ------------------------------------------------------------ fire points
 
 def crash_if_reached(first_iteration, num_iterations=1):
@@ -135,6 +201,59 @@ def crash_if_reached(first_iteration, num_iterations=1):
             os._exit(HARD_CRASH_EXIT_CODE)
         raise InjectedFault(
             f"injected crash at boosting iteration {k}")
+
+
+def rank_crash_if_reached(first_iteration, num_iterations=1):
+    """`rank_crash_at_iteration`: hard-kill (`os._exit(43)`) the
+    matching rank when iteration k falls inside
+    [first_iteration, first_iteration + num_iterations). No soft mode:
+    a rank death the peers must DETECT has to skip every finally/atexit
+    path, exactly like a preemption."""
+    spec = _rank_iter_spec("rank_crash_at_iteration")
+    if spec is None or _is_restarted_attempt():
+        return
+    rank, k = spec
+    if rank is not None and rank != current_rank():
+        return
+    if first_iteration <= k < first_iteration + num_iterations:
+        os._exit(HARD_CRASH_EXIT_CODE)
+
+
+def rank_hang_if_reached(first_iteration, num_iterations=1):
+    """`rank_hang_at_iteration`: the matching rank sleeps forever just
+    before iteration k. Peers entering the next collective then block —
+    the scenario the collective watchdog exists to bound. The hung
+    process itself keeps heartbeating (it is alive, just stuck), so
+    only a watchdog — not the heartbeat monitor — can catch this."""
+    spec = _rank_iter_spec("rank_hang_at_iteration")
+    if spec is None or _is_restarted_attempt():
+        return
+    rank, k = spec
+    if rank is not None and rank != current_rank():
+        return
+    if first_iteration <= k < first_iteration + num_iterations:
+        from .log import Log
+        Log.warning("injected hang at boosting iteration %d (rank %d)",
+                    k, current_rank())
+        while True:
+            time.sleep(3600)
+
+
+def heartbeat_suppressed(rank=None):
+    """`heartbeat_stale`: True when `rank`'s heartbeat publisher must
+    skip its writes (value = rank index; -1 suppresses every rank).
+    `rank` defaults to this process's rank; the heartbeat service
+    passes its own (tests run several ranks in one process)."""
+    value = _active.get("heartbeat_stale")
+    if value is None:
+        return False
+    try:
+        value = int(value)
+    except (TypeError, ValueError):
+        return False
+    if rank is None:
+        rank = current_rank()
+    return value in (-1, int(rank))
 
 
 def poison_gradients_if_armed(iteration, gradients, hessians):

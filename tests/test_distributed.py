@@ -17,9 +17,10 @@ import pytest
 
 BINARY_TRAIN = "/root/reference/examples/binary_classification/binary.train"
 
-# environment gate: every test here trains on the reference checkout's
-# example data, which is not part of this repo
-pytestmark = pytest.mark.skipif(
+# environment gate for the tests that train on the reference checkout's
+# example data (not part of this repo); the synthetic-data worker test
+# and the machine-list parse tests below run everywhere
+needs_reference_data = pytest.mark.skipif(
     not os.path.exists(BINARY_TRAIN),
     reason=f"requires reference example data at {BINARY_TRAIN}")
 
@@ -82,6 +83,7 @@ def _train_local(params, data_path=BINARY_TRAIN):
     return b
 
 
+@needs_reference_data
 def test_two_process_data_parallel_matches_single(tmp_path):
     # GLOBAL_ROWS makes the worker assert global_num_data==7000 and that
     # each rank holds a strict subset (catches a silently-unset rank
@@ -159,6 +161,49 @@ def test_two_round_rank_filtered_streaming_matches_single(tmp_path):
                                    rtol=2e-4, atol=1e-7)
 
 
+# ------------------------------------------------- machine-list parsing
+# (no reference data / no subprocess needed)
+
+def test_split_host_port_edge_cases():
+    from lightgbm_tpu.parallel.machines import _split_host_port
+    from lightgbm_tpu.utils.log import LightGBMError
+    assert _split_host_port("10.0.0.1:12400", 1) == ("10.0.0.1", "12400")
+    assert _split_host_port("[2001:db8::1]:12400", 1) == ("2001:db8::1",
+                                                          "12400")
+    with pytest.raises(LightGBMError, match="IPv6"):
+        _split_host_port("2001:db8::1:12400", 3)  # bare v6 + port
+    with pytest.raises(LightGBMError, match="bracketed"):
+        _split_host_port("[2001:db8::1]", 4)      # bracket, no port
+    with pytest.raises(LightGBMError, match="bracketed"):
+        _split_host_port("[2001:db8::1]:", 5)     # empty port
+
+
+def test_parse_machine_list_comments_blanks_and_dup_rejection(tmp_path):
+    from lightgbm_tpu.parallel.distributed import parse_machine_list
+    from lightgbm_tpu.utils.log import LightGBMError
+    path = tmp_path / "mlist.txt"
+    path.write_text(
+        "# full-line comment\n"
+        "10.0.0.1 12400   # trailing comment\n"
+        "\n"
+        "   \n"
+        "10.0.0.1:12401\n"
+        "[2001:db8::1]:12400\n")
+    assert parse_machine_list(str(path)) == [
+        ("10.0.0.1", 12400), ("10.0.0.1", 12401), ("2001:db8::1", 12400)]
+    # same host, same port: two ranks cannot share a listener — reject
+    # with the offending line, do not silently dedupe
+    path.write_text("127.0.0.1 12400\n127.0.0.1 12401\n"
+                    "127.0.0.1:12400  # dup of line 1\n")
+    with pytest.raises(LightGBMError, match="line 3 duplicates"):
+        parse_machine_list(str(path))
+    # a comment cannot hide a duplicate either
+    path.write_text("h1 12400\nh1 12400\n")
+    with pytest.raises(LightGBMError, match="line 2 duplicates"):
+        parse_machine_list(str(path))
+
+
+@needs_reference_data
 def test_two_process_partitioned_data_parallel(tmp_path):
     """Multi-host + the leaf-contiguous builder: two jax.distributed
     processes train the row-sharded partitioned core (per-shard packed

@@ -457,7 +457,6 @@ def test_parse_machine_list_formats(tmp_path):
         "2001:db8::2 12403\n"
         "[2001:db8::3] 12404\n"
         "\n"
-        "10.0.0.1 12400\n"  # duplicate: must not inflate rank count
     )
     assert parse_machine_list(str(path)) == [
         ("10.0.0.1", 12400),
@@ -466,6 +465,16 @@ def test_parse_machine_list_formats(tmp_path):
         ("2001:db8::2", 12403),
         ("2001:db8::3", 12404),
     ]
+
+
+def test_parse_machine_list_rejects_duplicate_host_port(tmp_path):
+    # two ranks cannot share one port: a duplicated line must fail with
+    # the offending line number, not silently shrink the rank count
+    from lightgbm_tpu.parallel.distributed import parse_machine_list
+    path = tmp_path / "mlist.txt"
+    path.write_text("10.0.0.1 12400\n10.0.0.2 12400\n10.0.0.1 12400\n")
+    with pytest.raises(LightGBMError, match="line 3 duplicates"):
+        parse_machine_list(str(path))
 
 
 def test_parse_machine_list_rejects_bare_ipv6_with_port(tmp_path):
@@ -557,3 +566,147 @@ def test_consume_counts_down():
     assert faults.consume("fail_distributed_init")
     assert faults.consume("fail_distributed_init")
     assert not faults.consume("fail_distributed_init")
+
+
+# ------------------------------------- malformed-row quarantine (CSV/TSV)
+
+def _messy_csv(tmp_path, name="messy.csv"):
+    path = tmp_path / name
+    path.write_text("1,0.5,0.25\n"
+                    "0,oops,0.5\n"       # bad cell
+                    "1,0.75,0.9\n"
+                    "0,0.1,0.2,77\n"     # wrong field count
+                    "1,0.3,0.4\n")
+    return str(path)
+
+
+def test_strict_mode_still_raises_on_malformed_row(tmp_path):
+    from lightgbm_tpu.io.parser import parse_text_file
+    with pytest.raises(Exception):
+        parse_text_file(_messy_csv(tmp_path))  # max_bad_rows defaults to 0
+
+
+def test_max_bad_rows_quarantines_and_diagnoses(tmp_path, capsys):
+    from lightgbm_tpu.io.parser import parse_text_file
+    label, feats, *_ = parse_text_file(_messy_csv(tmp_path),
+                                       max_bad_rows=2)
+    assert len(label) == 3 and feats.shape == (3, 2)
+    np.testing.assert_allclose(label, [1, 1, 1])
+    out = capsys.readouterr().out
+    assert "quarantined 2 malformed row(s)" in out
+    assert "line 2" in out and "'oops'" in out  # first offender named
+
+
+def test_max_bad_rows_budget_exceeded_is_fatal(tmp_path):
+    from lightgbm_tpu.io.parser import parse_text_file
+    with pytest.raises(LightGBMError, match="exceed max_bad_rows=1"):
+        parse_text_file(_messy_csv(tmp_path), max_bad_rows=1)
+
+
+def test_max_bad_rows_na_markers_are_not_bad(tmp_path):
+    # NA markers legitimately parse to NaN -> 0.0; they must not count
+    # against the quarantine budget (same as the strict path)
+    from lightgbm_tpu.io.parser import parse_text_file
+    path = tmp_path / "na.csv"
+    path.write_text("1,NA,0.25\n0,0.5,nan\n1,,0.9\n")
+    label, feats, *_ = parse_text_file(str(path), max_bad_rows=1)
+    assert len(label) == 3
+    assert feats[0, 0] == 0.0 and feats[1, 1] == 0.0
+
+
+def test_cli_max_bad_rows_trains_through(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    rng = np.random.RandomState(3)
+    x = rng.rand(200, 3)
+    y = (x[:, 0] > 0.5).astype(int)
+    rows = [",".join([str(y[i])] + [f"{v:.6f}" for v in x[i]])
+            for i in range(200)]
+    rows[50] = "1,corrupt,0.5,0.5"
+    path = tmp_path / "tr.csv"
+    path.write_text("\n".join(rows) + "\n")
+    cfg = Config.from_params({"objective": "binary", "max_bad_rows": 3,
+                              "min_data_in_leaf": 5,
+                              "enable_load_from_binary_file": False})
+    ds = DatasetLoader(cfg).load_from_file(str(path))
+    assert ds.num_data == 199  # one quarantined
+
+
+# ------------------------------------------- binary dataset validation
+
+def _make_binary_dataset(tmp_path):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    rng = np.random.RandomState(5)
+    x = rng.rand(250, 4)
+    y = (x[:, 0] > 0.5).astype(int)
+    csv = tmp_path / "bt.csv"
+    np.savetxt(csv, np.column_stack([y, x]), delimiter=",", fmt="%.6f")
+    cfg = Config.from_params({"objective": "binary",
+                              "is_save_binary_file": True,
+                              "min_data_in_leaf": 5})
+    DatasetLoader(cfg).load_from_file(str(csv))
+    return str(csv), str(csv) + ".bin", cfg
+
+
+def test_binary_dataset_roundtrip_and_version(tmp_path):
+    from lightgbm_tpu.io.dataset import CoreDataset
+    csv, bin_path, _ = _make_binary_dataset(tmp_path)
+    ds = CoreDataset.load_binary(bin_path)
+    assert ds.bins.shape[1] == 250
+    assert ds.metadata.num_data == 250
+
+
+def test_binary_dataset_truncated_fails_clearly(tmp_path):
+    from lightgbm_tpu.io.dataset import BinaryDatasetError, CoreDataset
+    csv, bin_path, _ = _make_binary_dataset(tmp_path)
+    blob = open(bin_path, "rb").read()
+    open(bin_path, "wb").write(blob[:len(blob) // 2])
+    with pytest.raises(BinaryDatasetError, match="truncated or corrupt"):
+        CoreDataset.load_binary(bin_path)
+
+
+def test_binary_dataset_foreign_npz_fails_clearly(tmp_path):
+    from lightgbm_tpu.io.dataset import BinaryDatasetError, CoreDataset
+    path = tmp_path / "foreign.bin"
+    with open(path, "wb") as f:
+        np.savez(f, foo=np.arange(3))
+    with pytest.raises(BinaryDatasetError, match="no magic entry"):
+        CoreDataset.load_binary(str(path))
+
+
+def test_binary_dataset_text_file_fails_clearly(tmp_path):
+    from lightgbm_tpu.io.dataset import BinaryDatasetError, CoreDataset
+    path = tmp_path / "plain.txt"
+    path.write_text("1,2,3\n")
+    with pytest.raises(BinaryDatasetError, match="bad magic") as ei:
+        CoreDataset.load_binary(str(path))
+    assert not ei.value.claimed  # a text file never claimed to be binary
+
+
+def test_binary_cache_falls_past_corrupt_sibling(tmp_path, capsys):
+    # mirror of the checkpoint loader's fall-past-corrupt: a rotten
+    # sibling .bin cache warns and rebuilds from text instead of dying
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    csv, bin_path, cfg = _make_binary_dataset(tmp_path)
+    blob = open(bin_path, "rb").read()
+    open(bin_path, "wb").write(blob[: len(blob) // 2])
+    cfg2 = Config.from_params({"objective": "binary",
+                               "min_data_in_leaf": 5})
+    ds = DatasetLoader(cfg2).load_from_file(csv)
+    assert ds.num_data == 250  # rebuilt from text
+    assert "ignoring unusable binary cache" in capsys.readouterr().out
+
+
+def test_binary_data_file_itself_corrupt_is_fatal(tmp_path):
+    # when the DATA argument is a broken binary dataset, falling back
+    # to the text parser would only produce garbage — fail with the
+    # real diagnosis instead
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    csv, bin_path, cfg = _make_binary_dataset(tmp_path)
+    blob = open(bin_path, "rb").read()
+    open(bin_path, "wb").write(blob[: len(blob) // 2])
+    with pytest.raises(LightGBMError, match="truncated or corrupt"):
+        DatasetLoader(cfg).load_from_file(bin_path)
