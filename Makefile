@@ -38,7 +38,15 @@ verify-dist:
 	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_supervisor.py tests/test_distributed.py -q
 
+# online-inference suite: CompiledPredictor parity across objectives,
+# NaN categorical routing, micro-batcher coalescing, streaming
+# predict_file, and the end-to-end `python -m lightgbm_tpu.serve`
+# smoke test — under a hard timeout so a hung server can never hang CI
+verify-serve:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_serving.py -q
+
 clean:
 	rm -f $(TARGET)
 
-.PHONY: all test-capi verify-fault verify-dist clean
+.PHONY: all test-capi verify-fault verify-dist verify-serve clean

@@ -40,7 +40,11 @@ LAST JSON line get the richest result; the FIRST is already complete.
 The `phases` dict carries the host-timed compile phase, per-op
 microprobe timings (`hist`/`split`/`score_update`, seconds per call —
 see phase_probe) and `compile_cache_hit` (1.0 when the persistent
-compile cache served the fused program's lowering).
+compile cache served the fused program's lowering). The `serving`
+dict (serving_probe) carries the online-inference trajectory:
+`serving.latency_p50_ms` (warm single-row) and
+`serving.throughput_rows_s` (sustained batched) vs the predict_raw
+host-loop `serving.baseline_rows_s`.
 """
 
 import json
@@ -484,6 +488,63 @@ def supervisor_probe():
     return out
 
 
+def serving_probe(booster, x):
+    """Online-serving microprobe (lightgbm_tpu/serving/): freeze the
+    trained model into a CompiledPredictor (AOT-warmed row buckets),
+    then measure (1) warm single-row request latency — p50/p99 of 100
+    calls, the number an online endpoint quotes — and (2) sustained
+    batched throughput over up to 100k rows, against the training-side
+    `predict_raw` HOST loop on the same rows as baseline (the pre-
+    serving-subsystem deployment story). Returns the result JSON's
+    `serving` dict: `serving.latency_p50_ms` / `serving.throughput_rows_s`
+    are the keys future BENCH_*.json track."""
+    out = {}
+    try:
+        from lightgbm_tpu.serving import CompiledPredictor
+
+        rows = np.ascontiguousarray(x[:min(len(x), 100_000)],
+                                    dtype=np.float32)
+        t0 = time.time()
+        pred = CompiledPredictor.from_booster(booster, max_batch_rows=4096)
+        out["warmup_s"] = round(time.time() - t0, 3)
+        out["compile_cache_hits"] = pred.stats["compile_cache_hits"]
+        row = rows[:1]
+        pred.predict(row)  # first-touch outside the timed window
+        lats = []
+        for _ in range(100):
+            t0 = time.time()
+            pred.predict(row)
+            lats.append(time.time() - t0)
+        lats.sort()  # nearest-rank percentiles of 100 samples
+        out["latency_p50_ms"] = round(lats[49] * 1e3, 4)
+        out["latency_p99_ms"] = round(lats[98] * 1e3, 4)
+        t0 = time.time()
+        pred.predict(rows)
+        out["throughput_rows_s"] = round(len(rows) / (time.time() - t0), 1)
+        prev = os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT")
+        os.environ["LIGHTGBM_TPU_DEVICE_PREDICT"] = "0"  # force host loop
+        try:
+            t0 = time.time()
+            booster.predict_raw(rows)  # the callee the key names
+            base_s = time.time() - t0
+        finally:
+            if prev is None:
+                os.environ.pop("LIGHTGBM_TPU_DEVICE_PREDICT", None)
+            else:
+                os.environ["LIGHTGBM_TPU_DEVICE_PREDICT"] = prev
+        out["baseline_rows_s"] = round(len(rows) / base_s, 1)
+        out["vs_predict_raw"] = round(
+            out["throughput_rows_s"] / max(out["baseline_rows_s"], 1e-9), 3)
+        out["probe_rows"] = len(rows)
+        # zero means every request shape was AOT-covered (the serving
+        # acceptance bar: a warm request never recompiles)
+        out["cold_dispatches"] = pred.stats["cold_dispatches"]
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"serving probe failed: {e}")
+        out["error"] = str(e)[-200:]
+    return out
+
+
 def run_child():
     """Child mode: one isolated measurement. Env: BENCH_CHILD_ROWS,
     optional BENCH_CHILD_CPU / LIGHTGBM_TPU_DISABLE_PALLAS /
@@ -546,6 +607,11 @@ def run_child():
     if n_rows >= 1_000_000 and predict_s < 0.05:
         pred["predict_memo_suspect"] = True
     print("CHILD_PREDICT " + json.dumps(pred), flush=True)
+    # serving microprobe LAST: train + predict results are already
+    # printed, so a serving-path failure can only lose its own line
+    _mark("probing serving path (CompiledPredictor latency/throughput)")
+    print("CHILD_SERVING " + json.dumps(serving_probe(booster, x_raw)),
+          flush=True)
 
 
 def measure(n_rows, n_iters, timeout_s, force_cpu=False,
@@ -586,6 +652,8 @@ def measure(n_rows, n_iters, timeout_s, force_cpu=False,
             res = json.loads(line.split(" ", 1)[1])
         elif line.startswith("CHILD_PREDICT ") and res is not None:
             res.update(json.loads(line.split(" ", 1)[1]))
+        elif line.startswith("CHILD_SERVING ") and res is not None:
+            res["serving"] = json.loads(line.split(" ", 1)[1])
     if res is not None:
         return res, "ok"
     tail = ((r.stderr or "") + (r.stdout or ""))[-250:].replace("\n", " ")
@@ -699,6 +767,11 @@ def _format_result(res, reason):
         result["fallback_note"] = res["fallback_from"]
     if res.get("phases"):
         result["phases"] = res["phases"]
+    if res.get("serving"):
+        # serving.latency_p50_ms / serving.throughput_rows_s etc.
+        # (serving_probe) — the online-inference trajectory across
+        # BENCH_*.json
+        result["serving"] = res["serving"]
     if res.get("memo_suspect"):
         result["memo_suspect"] = True
     if res.get("predict_memo_suspect"):

@@ -47,17 +47,34 @@ class Predictor:
         return self.boosting.predict(feats, self.num_iteration)
 
     def predict_file(self, data_filename, result_filename, has_header=False,
-                     label_column="", max_bad_rows=0):
-        from .io.parser import parse_text_file
-        _, feats, _, _, _ = parse_text_file(
-            data_filename, has_header=has_header, label_column=label_column,
-            max_bad_rows=max_bad_rows)
-        out = np.atleast_2d(self.predict_matrix(feats))
+                     label_column="", max_bad_rows=0, chunk_rows=65536):
+        """Stream the input in bounded `chunk_rows`-row chunks (a
+        serving-scale scoring file never materializes as one matrix)
+        and append each chunk's predictions to the TSV as it lands —
+        same output as the one-shot parse, O(chunk) peak memory."""
+        from .io.parser import iter_text_file_chunks
+        n_feat = self.boosting.max_feature_idx + 1
+        n_done = 0
         with open(result_filename, "w") as fout:
-            for row in out:
-                fout.write("\t".join(f"{v:g}" for v in np.atleast_1d(row)) + "\n")
-        Log.info("Finished prediction and saved result to %s",
-                 str(result_filename))
+            # keep_nan: a missing cell must ride the model's default-
+            # direction routing (right child), exactly like a null sent
+            # to the serving endpoint — not collapse to literal 0.0
+            for _, feats in iter_text_file_chunks(
+                    data_filename, chunk_rows, has_header=has_header,
+                    label_column=label_column, max_bad_rows=max_bad_rows,
+                    keep_nan=True):
+                if feats.shape[1] < n_feat:
+                    # LibSVM chunk width is per-chunk (trailing absent
+                    # features); the model defines the true width
+                    feats = np.pad(feats,
+                                   ((0, 0), (0, n_feat - feats.shape[1])))
+                out = np.atleast_2d(self.predict_matrix(feats))
+                for row in out:
+                    fout.write("\t".join(f"{v:g}"
+                                         for v in np.atleast_1d(row)) + "\n")
+                n_done += len(out)
+        Log.info("Finished prediction of %d rows and saved result to %s",
+                 n_done, str(result_filename))
 
     def init_score_fun(self):
         """PredictFunction used by DatasetLoader to seed init scores from a
@@ -378,6 +395,10 @@ class Application:
         self.boosting = create_boosting("gbdt", cfg.input_model)
         with open(cfg.input_model) as f:
             self.boosting.load_model_from_string(f.read())
+        # a predict-only booster never runs reset_training_data, so the
+        # routing knobs must be applied here or they would be dead on
+        # the one path documented to consume them
+        self.boosting.apply_predict_config(cfg)
         Log.info("Finished initializing prediction")
 
     def predict(self):
@@ -390,7 +411,8 @@ class Application:
         predictor.predict_file(cfg.data, cfg.output_result,
                                has_header=cfg.has_header,
                                label_column=cfg.label_column,
-                               max_bad_rows=cfg.max_bad_rows)
+                               max_bad_rows=cfg.max_bad_rows,
+                               chunk_rows=cfg.predict_chunk_rows)
         Log.info("Finished prediction")
 
 

@@ -257,6 +257,22 @@ class Config:
     # (io/parser.py) instead of failing on the first one; 0 = strict
     max_bad_rows: int = 0
 
+    # --- prediction routing (models/gbdt.py predict_raw; no reference
+    # equivalent — the reference predicts per-row under OpenMP) ---
+    # rows x trees at or above this run the jitted device traversal
+    # instead of the host loop ("auto" routing)
+    device_predict_cells: int = 20_000_000
+    # host-path (rows x trees) cells per traversal block (peak memory)
+    host_traverse_cells: int = 4_000_000
+    # "auto" = cells-threshold routing; "true" forces the device path,
+    # "false" forces the host path. The LIGHTGBM_TPU_DEVICE_PREDICT env
+    # flag overrides this knob when set (docs/Parameters.md)
+    device_predict: str = "auto"
+    # task=predict streams the input file in chunks of this many rows
+    # (application.py predict_file) so serving-scale scoring files never
+    # materialize as one matrix
+    predict_chunk_rows: int = 65536
+
     # derived
     is_parallel: bool = False
     is_parallel_find_bin: bool = False
@@ -415,6 +431,14 @@ class Config:
               "collective_timeout_s should be >= 0")
         check(self.max_restarts >= 0, "max_restarts should be >= 0")
         check(self.max_bad_rows >= 0, "max_bad_rows should be >= 0")
+        check(self.device_predict_cells > 0,
+              "device_predict_cells should be > 0")
+        check(self.host_traverse_cells > 0,
+              "host_traverse_cells should be > 0")
+        check(str(self.device_predict).lower() in ("auto", "true", "false"),
+              "device_predict must be auto|true|false")
+        check(self.predict_chunk_rows > 0,
+              "predict_chunk_rows should be > 0")
         from .utils.guardrails import POLICIES
         check(self.nonfinite_guard in POLICIES,
               "nonfinite_guard must be one of " + "|".join(POLICIES))

@@ -1,7 +1,7 @@
 from .bin_mapper import BinMapper
 from .metadata import Metadata
 from .dataset import CoreDataset, DatasetLoader
-from .parser import detect_format, parse_text_file
+from .parser import detect_format, iter_text_file_chunks, parse_text_file
 
 __all__ = ["BinMapper", "Metadata", "CoreDataset", "DatasetLoader",
-           "detect_format", "parse_text_file"]
+           "detect_format", "iter_text_file_chunks", "parse_text_file"]

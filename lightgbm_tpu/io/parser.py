@@ -72,6 +72,17 @@ def detect_format(path) -> str:
     return "tsv"
 
 
+def _densify_libsvm(labels, rows, max_idx):
+    """(label f32, dense (N, max_idx+1) f64) from parsed LibSVM pairs —
+    shared by the one-shot and streaming paths so row assembly cannot
+    diverge."""
+    mat = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for r, pairs in enumerate(rows):
+        for i, v in pairs:
+            mat[r, i] = v
+    return np.asarray(labels, dtype=np.float32), mat
+
+
 def _parse_libsvm(path, has_header):
     """LibSVM: `label idx:val idx:val ...`; indices are used as-is
     (the reference's LibSVMParser does not shift them, parser.hpp:77-112)."""
@@ -92,12 +103,8 @@ def _parse_libsvm(path, has_header):
                 if i > max_idx:
                     max_idx = i
             rows.append(pairs)
-    n = len(rows)
-    mat = np.zeros((n, max_idx + 1), dtype=np.float64)
-    for r, pairs in enumerate(rows):
-        for i, v in pairs:
-            mat[r, i] = v
-    return np.asarray(labels, dtype=np.float32), mat, None
+    label, mat = _densify_libsvm(labels, rows, max_idx)
+    return label, mat, None
 
 
 def _first_offender(path, sep, has_header, ncols):
@@ -135,6 +142,20 @@ def _first_offender(path, sep, has_header, ncols):
     return "not re-locatable in a raw scan (quoting?)"
 
 
+def _coerce_quarantine(df):
+    """Quarantine rule shared by the one-shot and streaming CSV/TSV
+    parsers: a bad CELL is one coerced to NaN where the raw text was
+    neither empty nor a recognized NA marker (those legitimately parse
+    to NaN and become 0.0 downstream, same as the strict path).
+    Returns (numeric DataFrame of the GOOD rows, n bad rows dropped)."""
+    import pandas as pd
+
+    numeric = df.apply(pd.to_numeric, errors="coerce")
+    bad_cells = numeric.isna().to_numpy() & ~df.isna().to_numpy()
+    bad_rows = bad_cells.any(axis=1)
+    return numeric[~bad_rows], int(bad_rows.sum())
+
+
 def _read_csv_quarantine(path, sep, has_header, max_bad_rows):
     """Tolerant CSV/TSV fallback: rows with unparsable cells (and
     structurally bad lines) are QUARANTINED — counted, diagnosed, and
@@ -156,13 +177,8 @@ def _read_csv_quarantine(path, sep, has_header, max_bad_rows):
     df = pd.read_csv(path, sep=sep, header=0 if has_header else None,
                      dtype=str, na_values=NA_VALUES, engine="python",
                      on_bad_lines=on_bad)
-    numeric = df.apply(pd.to_numeric, errors="coerce")
-    # a bad CELL coerced to NaN where the raw text was neither empty
-    # nor a recognized NA marker (those legitimately parse to NaN and
-    # become 0.0 downstream, same as the strict path)
-    bad_cells = numeric.isna().to_numpy() & ~df.isna().to_numpy()
-    bad_rows = bad_cells.any(axis=1)
-    n_bad = int(bad_rows.sum()) + len(bad_lines)
+    numeric, n_bad_cells = _coerce_quarantine(df)
+    n_bad = n_bad_cells + len(bad_lines)
     if n_bad:
         first = _first_offender(path, sep, has_header, df.shape[1])
         if n_bad > max_bad_rows:
@@ -172,7 +188,117 @@ def _read_csv_quarantine(path, sep, has_header, max_bad_rows):
         Log.warning("quarantined %d malformed row(s) in %s "
                     "(max_bad_rows=%d); first offender: %s",
                     n_bad, str(path), max_bad_rows, first)
-    return numeric[~bad_rows], n_bad
+    return numeric, n_bad
+
+
+def _resolve_label_idx(label_column, names, path):
+    """Reference label-column resolution (`DatasetLoader::SetHeader`):
+    default column 0, `name:xxx` selects by header name, plain integers
+    are file-column indices."""
+    if label_column == "":
+        return 0
+    if str(label_column).startswith("name:"):
+        want = str(label_column)[5:]
+        if names is None or want not in names:
+            Log.fatal("Could not find label column %s in data file", want)
+        return names.index(want)
+    return int(label_column)
+
+
+def iter_text_file_chunks(path, chunk_rows, has_header=False,
+                          label_column="", max_bad_rows=0,
+                          keep_nan=False):
+    """Stream a data file as (label, features) float chunks of at most
+    `chunk_rows` rows — the bounded-memory twin of parse_text_file
+    (identical per-row semantics: same format sniffing, NA handling,
+    label-column resolution and quarantine rule), used by the predict
+    path so serving-scale scoring files never materialize whole
+    (application.py Predictor.predict_file).
+
+    `keep_nan=True` preserves NA cells as NaN instead of the training
+    ingestion's NaN->0.0 collapse (binning needs finite inputs), so
+    file prediction routes missing values exactly like the serving
+    endpoint: right child on numeric AND categorical splits (reference
+    default-direction semantics). NA labels also stay NaN — the
+    predict path never reads them.
+
+    CSV/TSV chunks all share the file's column count; LibSVM chunk
+    width is the largest feature index seen IN THAT CHUNK + 1 — callers
+    align widths (the predict path pads to the model's feature count).
+    The `max_bad_rows` quarantine budget is shared across the whole
+    file, matching the one-shot parse."""
+    import pandas as pd
+
+    fmt = detect_format(path)
+    if fmt == "libsvm":
+        labels, rows, max_idx = [], [], -1
+
+        def flush():
+            return _densify_libsvm(labels, rows, max_idx)
+
+        with open(path, "r") as f:
+            if has_header:
+                next(f, None)
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split()
+                labels.append(float(parts[0]))
+                pairs = libsvm_pairs(parts[1:])
+                for i, _ in pairs:
+                    max_idx = max(max_idx, i)
+                rows.append(pairs)
+                if len(rows) >= chunk_rows:
+                    yield flush()
+                    labels, rows, max_idx = [], [], -1
+        if rows:
+            yield flush()
+        return
+
+    sep = "," if fmt == "csv" else "\t"
+    n_bad = 0
+    bad_lines = []
+
+    def on_bad(fields):
+        bad_lines.append(fields)
+        return None  # skip
+
+    if max_bad_rows > 0:
+        reader = pd.read_csv(path, sep=sep,
+                             header=0 if has_header else None,
+                             dtype=str, na_values=NA_VALUES,
+                             engine="python", on_bad_lines=on_bad,
+                             chunksize=chunk_rows)
+    else:
+        reader = pd.read_csv(path, sep=sep,
+                             header=0 if has_header else None,
+                             dtype=np.float64, na_values=NA_VALUES,
+                             chunksize=chunk_rows)
+    label_idx = None
+    for df in reader:
+        if label_idx is None:
+            names = ([str(c) for c in df.columns] if has_header else None)
+            label_idx = _resolve_label_idx(label_column, names, path)
+        if max_bad_rows > 0:
+            good, n_bad_rows = _coerce_quarantine(df)
+            n_bad += n_bad_rows + len(bad_lines)
+            bad_lines.clear()
+            if n_bad > max_bad_rows:
+                Log.fatal("%d malformed rows in %s exceed max_bad_rows=%d; "
+                          "first offender: %s", n_bad, str(path),
+                          max_bad_rows,
+                          _first_offender(path, sep, has_header,
+                                          df.shape[1]))
+            df = good
+        data = df.to_numpy(dtype=np.float64)
+        if not keep_nan:
+            data = np.nan_to_num(data, nan=0.0)
+        label = data[:, label_idx].astype(np.float32)
+        yield label, np.delete(data, label_idx, axis=1)
+    if n_bad:
+        Log.warning("quarantined %d malformed row(s) in %s "
+                    "(max_bad_rows=%d)", n_bad, str(path), max_bad_rows)
 
 
 def parse_text_file(path, has_header=False, label_column="",
@@ -206,15 +332,7 @@ def parse_text_file(path, has_header=False, label_column="",
     data = df.to_numpy(dtype=np.float64)
     data = np.nan_to_num(data, nan=0.0)
 
-    label_idx = 0
-    if label_column != "":
-        if str(label_column).startswith("name:"):
-            want = str(label_column)[5:]
-            if names is None or want not in names:
-                Log.fatal("Could not find label column %s in data file", want)
-            label_idx = names.index(want)
-        else:
-            label_idx = int(label_column)
+    label_idx = _resolve_label_idx(label_column, names, path)
 
     label = data[:, label_idx].astype(np.float32)
     # keep float64: the reference parses and bins in double (parser.hpp),

@@ -33,6 +33,59 @@ from .tree_learner import create_tree_learner
 K_MIN_SCORE = -np.inf
 
 
+def f32_safe_thresholds(thr, dt):
+    """f32 cast of f64 numeric thresholds rounded toward -inf so
+    `x <= thr32` equals the f64 `x <= thr` for every f32-representable
+    x (round-to-nearest could lift thr32 ABOVE thr and flip rows
+    landing in between). Categorical thresholds are exact category
+    ids: f32 holds ints < 2^24 exactly, and the id-vs-id equality is
+    unaffected by the adjustment only applied to numeric nodes.
+    Shared by the training-side device predictor and the serving-side
+    CompiledPredictor (serving/compiled_model.py)."""
+    thr32 = thr.astype(np.float32)
+    numeric = dt != Tree.CATEGORICAL
+    lifted = numeric & (thr32.astype(np.float64) > thr)
+    return np.where(lifted,
+                    np.nextafter(thr32, np.float32(-np.inf),
+                                 dtype=np.float32),
+                    thr32)
+
+
+def device_traverse(xb, sf, thr, cat, lc, rc, node0, depth):
+    """Lockstep device traversal of a (B, F) f32 row block through all
+    stacked trees: every (row, tree) pair walks `depth` steps (leaves
+    freeze as ~leaf in the child arrays) and the final (B, T) node
+    states (~leaf encoded) come back. NaN: numeric compares send NaN
+    right (fval <= thr is False) and categorical compares send NaN
+    right too (a missing value is not a category id — reference
+    default-direction semantics). Traced inside jitted callers
+    (GBDT._predict_block_device, serving kernels)."""
+    b = xb.shape[0]
+    t_cnt = sf.shape[0]
+    t_idx = jnp.arange(t_cnt)
+    node_init = jnp.broadcast_to(node0[None, :], (b, t_cnt))
+    xs = jnp.nan_to_num(xb)  # the int cast below needs a finite input
+
+    def step(_, node):
+        nd = jnp.maximum(node, 0)
+        feat = sf[t_idx[None, :], nd]                       # (B, T)
+        th = thr[t_idx[None, :], nd]
+        is_c = cat[t_idx[None, :], nd]
+        rows = jnp.arange(b)[:, None]
+        fval = xb[rows, feat]
+        fcat = xs[rows, feat]
+        go_left = jnp.where(
+            is_c,
+            (fcat.astype(jnp.int32) == th.astype(jnp.int32))
+            & ~jnp.isnan(fval),
+            fval <= th)
+        nxt = jnp.where(go_left, lc[t_idx[None, :], nd],
+                        rc[t_idx[None, :], nd])
+        return jnp.where(node < 0, node, nxt)
+
+    return jax.lax.fori_loop(0, depth, step, node_init)
+
+
 class LazyTree:
     """A Tree whose arrays still live on device.
 
@@ -300,6 +353,7 @@ class GBDT:
         self.early_stopping_round = config.early_stopping_round
         self.shrinkage_rate = config.learning_rate
         self.objective = objective
+        self.apply_predict_config(config)
         self._bag_fn = None   # bakes in config/metadata; rebuild lazily
         self._bag_rows = None
         self._bag_window = None
@@ -974,7 +1028,11 @@ class GBDT:
 
     # rows*trees above this run the jitted device traversal (the
     # reference parallelizes prediction with OpenMP, predictor.hpp:82-130;
-    # here rows AND trees vectorize on device, class reduction on the MXU)
+    # here rows AND trees vectorize on device, class reduction on the MXU).
+    # Class-level defaults; `device_predict_cells` / `host_traverse_cells`
+    # config knobs override per booster (reset_training_data), and the
+    # `device_predict` knob / LIGHTGBM_TPU_DEVICE_PREDICT env flag force
+    # the path outright (docs/Parameters.md).
     DEVICE_PREDICT_CELLS = 20_000_000
     # single-dispatch (lax.map) predict when the padded f32 input fits
     # this budget; beyond it, per-block dispatches bound device memory
@@ -993,20 +1051,9 @@ class GBDT:
             return cached[1]
         sf, thr, dt, lc, rc, lv, has_split, depth = \
             self._stacked_model_arrays(n_used)
-        # Numeric thresholds are f64 on the host path; round the f32 cast
-        # toward -inf so `x <= thr32` equals the f64 `x <= thr` for every
-        # f32-representable x (round-to-nearest could lift thr32 ABOVE
-        # thr and flip rows landing in between). Categorical thresholds
-        # are exact category ids: f32 holds ints < 2^24 exactly, and the
-        # id-vs-id equality below is unaffected by the adjustment only
-        # applied to numeric nodes.
-        thr32 = thr.astype(np.float32)
-        numeric = dt != Tree.CATEGORICAL
-        lifted = numeric & (thr32.astype(np.float64) > thr)
-        thr32 = np.where(lifted,
-                         np.nextafter(thr32, np.float32(-np.inf),
-                                      dtype=np.float32),
-                         thr32)
+        # numeric thresholds are f64 on the host path; see
+        # f32_safe_thresholds for the round-toward--inf cast contract
+        thr32 = f32_safe_thresholds(thr, dt)
         dev = (jnp.asarray(sf), jnp.asarray(thr32, jnp.float32),
                jnp.asarray(dt == Tree.CATEGORICAL),
                jnp.asarray(lc), jnp.asarray(rc),
@@ -1020,34 +1067,13 @@ class GBDT:
     @functools.partial(jax.jit, static_argnums=(9,))
     def _predict_block_device(xb, sf, thr, cat, lc, rc, lv, node0,
                               cls_onehot, depth):
-        """(B, F) raw f32 rows -> (B, K) class sums: every (row, tree)
-        pair walks in lockstep for `depth` steps (leaves freeze as ~leaf
-        in the child arrays), then the per-class reduction runs as a
-        (B, T) x (T, K) matmul inside the same program (MXU). NaN:
-        numeric compares send NaN right (fval <= thr is False),
-        matching the host path."""
-        b = xb.shape[0]
-        t_cnt = sf.shape[0]
-        t_idx = jnp.arange(t_cnt)
-        node_init = jnp.broadcast_to(node0[None, :], (b, t_cnt))
-        xs = jnp.nan_to_num(xb)  # categorical compare needs a finite cast
-
-        def step(_, node):
-            nd = jnp.maximum(node, 0)
-            feat = sf[t_idx[None, :], nd]                       # (B, T)
-            th = thr[t_idx[None, :], nd]
-            is_c = cat[t_idx[None, :], nd]
-            rows = jnp.arange(b)[:, None]
-            fval = xb[rows, feat]
-            fcat = xs[rows, feat]
-            go_left = jnp.where(is_c,
-                                fcat.astype(jnp.int32) == th.astype(jnp.int32),
-                                fval <= th)
-            nxt = jnp.where(go_left, lc[t_idx[None, :], nd],
-                            rc[t_idx[None, :], nd])
-            return jnp.where(node < 0, node, nxt)
-
-        node = jax.lax.fori_loop(0, depth, step, node_init)
+        """(B, F) raw f32 rows -> (B, K) class sums: the lockstep
+        traversal (device_traverse; NaN routes right on BOTH numeric
+        and categorical nodes, matching the host path), then the
+        per-class reduction runs as a (B, T) x (T, K) matmul inside
+        the same program (MXU)."""
+        node = device_traverse(xb, sf, thr, cat, lc, rc, node0, depth)
+        t_idx = jnp.arange(sf.shape[0])
         vals = lv[t_idx[None, :], ~node]                        # (B, T)
         return vals @ cls_onehot                                # (B, K)
 
@@ -1121,8 +1147,7 @@ class GBDT:
         out = np.zeros((n, self.num_class))
         if n_used == 0 or n == 0:
             return out
-        if (n * n_used >= self.DEVICE_PREDICT_CELLS
-                and os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT", "1") != "0"):
+        if self._use_device_predict(n, n_used):
             return self._predict_raw_device(x, n_used)
         lv = self._stacked_model_arrays(n_used)[5]
         t_cnt = lv.shape[0]
@@ -1136,6 +1161,33 @@ class GBDT:
                 out[s:s + block, k] = vals[:, cls == k].sum(axis=1)
         return out
 
+    def apply_predict_config(self, config):
+        """Plumb the predict-routing knobs (docs/Parameters.md) onto
+        this booster. Called from reset_training_data AND the predict-
+        only CLI path (application.py init_predict), which loads models
+        without ever training; class attrs remain the defaults for
+        boosters that never saw a config."""
+        self.DEVICE_PREDICT_CELLS = int(getattr(
+            config, "device_predict_cells", self.DEVICE_PREDICT_CELLS))
+        self._HOST_TRAVERSE_CELLS = int(getattr(
+            config, "host_traverse_cells", self._HOST_TRAVERSE_CELLS))
+        self.device_predict = str(getattr(config, "device_predict", "auto"))
+
+    def _use_device_predict(self, n, n_used):
+        """Route a predict_raw call host vs device. The env flag wins
+        when set ("0"/"false" forces host, "force"/"true" forces
+        device), else the `device_predict` config knob, else the
+        cells-threshold auto rule (docs/Parameters.md)."""
+        knob = os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT")
+        if knob in (None, "", "1"):  # "1" was the legacy auto default
+            knob = str(getattr(self, "device_predict", "auto"))
+        knob = knob.lower()
+        if knob in ("0", "false", "off", "-"):
+            return False
+        if knob in ("force", "true", "+"):
+            return True
+        return n * n_used >= self.DEVICE_PREDICT_CELLS
+
     def _traverse_host(self, xb, n_used):
         """Host traversal of one row block through all stacked trees:
         returns the final (b, T) node states (~leaf encoded). Shared by
@@ -1144,7 +1196,7 @@ class GBDT:
             self._stacked_model_arrays(n_used)
         t_cnt = sf.shape[0]
         t_idx = np.arange(t_cnt)
-        xbs = np.nan_to_num(xb)  # finite cast for the categorical compare
+        xbs = np.nan_to_num(xb)  # the int cast below needs a finite input
         node = np.where(has_split[None, :], 0, ~0).astype(np.int32)
         node = np.broadcast_to(node, (len(xb), t_cnt)).copy()
         for _ in range(depth):
@@ -1157,8 +1209,12 @@ class GBDT:
             d = dt[t_idx[None, :], nd]
             fval = xb[np.arange(len(xb))[:, None], feat]
             fcat = xbs[np.arange(len(xb))[:, None], feat]
+            # NaN routes RIGHT on categorical nodes too (a missing value
+            # is not a category id; reference default-direction
+            # semantics) — numeric NaN already goes right via <= False
             go_left = np.where(d == Tree.CATEGORICAL,
-                               fcat.astype(np.int64) == th.astype(np.int64),
+                               (fcat.astype(np.int64) == th.astype(np.int64))
+                               & ~np.isnan(fval),
                                fval <= th)
             nxt = np.where(go_left, lc[t_idx[None, :], nd],
                            rc[t_idx[None, :], nd])
@@ -1182,7 +1238,8 @@ class GBDT:
         n_used = self._num_used_models(num_iteration)
         n = x.shape[0]
         if n_used == 0 or n == 0:
-            return np.zeros((n, 0), dtype=np.int32)
+            # (N, T) even when empty: vstacking chunked calls must work
+            return np.zeros((n, n_used), dtype=np.int32)
         block = max(1, min(n, self._HOST_TRAVERSE_CELLS // n_used))
         outs = []
         for s in range(0, n, block):

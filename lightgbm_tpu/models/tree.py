@@ -83,9 +83,13 @@ class Tree:
             thr = self.threshold[nd]
             dt = self.decision_type[nd]
             fval = x[active, feat]
+            # NaN routes RIGHT everywhere: numeric via `<=` being False,
+            # categorical explicitly (a missing value is not a category
+            # id — without the isnan mask the nan_to_num cast would
+            # silently match category 0)
             go_left = np.where(dt == self.CATEGORICAL,
-                               np.nan_to_num(fval).astype(np.int64)
-                               == thr.astype(np.int64),
+                               (np.nan_to_num(fval).astype(np.int64)
+                                == thr.astype(np.int64)) & ~np.isnan(fval),
                                fval <= thr)
             nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
             node[active] = nxt

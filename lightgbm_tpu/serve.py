@@ -1,0 +1,9 @@
+"""`python -m lightgbm_tpu.serve model.txt --port 8099`: the serving
+CLI (serving/server.py; docs/Serving.md)."""
+
+import sys
+
+from .serving.server import main
+
+if __name__ == "__main__":
+    sys.exit(main())

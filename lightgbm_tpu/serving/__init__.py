@@ -1,0 +1,15 @@
+"""Online inference subsystem: frozen compiled predictor, micro-batching
+queue, HTTP serving endpoint (docs/Serving.md).
+
+Import cost note: this package pulls in jax (via compiled_model); the
+top-level `lightgbm_tpu` package does NOT import it so batch-training
+users never pay for the serving stack.
+"""
+
+from .batcher import MicroBatcher
+from .compiled_model import CompiledPredictor
+from .metrics import ServingMetrics
+from .server import make_server
+
+__all__ = ["CompiledPredictor", "MicroBatcher", "ServingMetrics",
+           "make_server"]

@@ -1,0 +1,147 @@
+"""Micro-batching queue: coalesce concurrent requests into one dispatch.
+
+A single-row device dispatch and a 256-row dispatch cost nearly the
+same wall time (the per-dispatch overhead dominates at serving batch
+sizes), so under concurrency the winning shape is: queue requests for
+at most `max_wait_ms`, concatenate whatever arrived into ONE padded
+device call (CompiledPredictor pads to its row-count buckets), then
+slice the result back per request. Classic dynamic batching — the same
+design GPU inference servers use — implemented here with a single
+worker thread and stdlib primitives only.
+
+Latency contract: a lone request waits at most `max_wait_ms` beyond
+its own dispatch; a full batch (`max_batch_rows` queued) dispatches
+immediately. Requests of different kinds (predict / raw / leaf) never
+share a dispatch — the worker drains the oldest kind first.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+KINDS = ("predict", "raw", "leaf")
+
+
+class MicroBatcher:
+    """Coalesces `submit()`ed row batches into bucketed device
+    dispatches against a CompiledPredictor (or anything exposing
+    predict / predict_raw / predict_leaf_index)."""
+
+    def __init__(self, predictor, max_batch_rows=None, max_wait_ms=2.0,
+                 metrics=None):
+        self.predictor = predictor
+        self.max_batch_rows = int(max_batch_rows
+                                  or getattr(predictor, "max_batch_rows",
+                                             4096))
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = []          # [(kind, rows, future, t_enqueue)]
+        self._closed = False
+        self._worker = threading.Thread(target=self._run,
+                                        name="micro-batcher", daemon=True)
+        self._worker.start()
+
+    # ---------------------------------------------------------------- client
+    def submit(self, rows, kind="predict"):
+        """Enqueue one request; returns a concurrent.futures.Future
+        resolving to that request's own result rows."""
+        if kind not in KINDS:
+            raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
+        rows = np.atleast_2d(np.asarray(rows, dtype=np.float32))
+        canon = getattr(self.predictor, "_canon", None)
+        if canon is not None:
+            # canonicalize width HERE so requests that are valid alone
+            # (narrow/wide rows) also concatenate with each other
+            rows = canon(rows)
+        fut = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._queue.append((kind, rows, fut, time.monotonic()))
+            self._cond.notify()
+        return fut
+
+    def predict(self, rows, kind="predict", timeout=None):
+        """Blocking submit: the calling thread rides the next coalesced
+        batch."""
+        return self.submit(rows, kind).result(timeout=timeout)
+
+    def queue_depth(self):
+        with self._lock:
+            return len(self._queue)
+
+    def close(self, timeout=5.0):
+        """Drain and stop the worker. Pending futures still resolve."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._worker.join(timeout=timeout)
+
+    # ---------------------------------------------------------------- worker
+    def _take_batch(self):
+        """Wait for work, give the head request `max_wait_s` to attract
+        company, then pull every same-kind request (up to
+        max_batch_rows). Returns (kind, [(rows, future)]) or None when
+        closed and drained. Runs with the lock held via _cond."""
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait()
+            if not self._queue:
+                return None  # closed and drained
+            # the single worker is the only consumer, so the head (and
+            # its arrival time) cannot change while we wait for company
+            deadline = self._queue[0][3] + self.max_wait_s
+            kind = self._queue[0][0]
+            while True:
+                rows_queued = sum(r.shape[0] for k, r, _, _ in self._queue
+                                  if k == kind)
+                remaining = deadline - time.monotonic()
+                if (rows_queued >= self.max_batch_rows or remaining <= 0
+                        or self._closed):
+                    break
+                self._cond.wait(timeout=remaining)
+            batch, rest, taken = [], [], 0
+            for item in self._queue:
+                k, rows, fut, _ = item
+                if k == kind and taken < self.max_batch_rows:
+                    batch.append((rows, fut))
+                    taken += rows.shape[0]
+                else:
+                    rest.append(item)
+            self._queue = rest
+            return kind, batch
+
+    def _run(self):
+        while True:
+            got = self._take_batch()
+            if got is None:
+                return
+            kind, batch = got
+            try:
+                # inside the try: ANY failure (even a concat shape
+                # mismatch) must fail this batch's futures, never kill
+                # the single worker thread
+                rows = np.concatenate([r for r, _ in batch], axis=0)
+                if kind == "leaf":
+                    out = self.predictor.predict_leaf_index(rows)
+                elif kind == "raw":
+                    out = self.predictor.predict_raw(rows)
+                else:
+                    out = self.predictor.predict(rows)
+            except Exception as e:
+                # errors are counted per REQUEST by whoever consumes the
+                # futures (the HTTP handler) — counting the batch here
+                # too would double-book one failure
+                for _, fut in batch:
+                    fut.set_exception(e)
+                continue
+            if self.metrics is not None:
+                self.metrics.record_batch(rows.shape[0], len(batch))
+            s = 0
+            for r, fut in batch:
+                fut.set_result(out[s:s + r.shape[0]])
+                s += r.shape[0]

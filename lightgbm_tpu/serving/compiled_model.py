@@ -1,0 +1,311 @@
+"""CompiledPredictor: a trained ensemble frozen for online serving.
+
+The training-side predict path (models/gbdt.py predict_raw) re-derives
+stacked arrays per call and compiles on first use — fine for batch
+scoring, wrong for a standing service where the FIRST request must not
+pay a trace+compile. This module freezes the model once:
+
+- the ensemble becomes immutable padded SoA device arrays (class-major
+  stacked split_feature / threshold / decision_type / left_child /
+  right_child / leaf_value, via GBDT._stacked_model_arrays), with the
+  same round-toward--inf f32 threshold cast as the training-side device
+  predictor (models/gbdt.py f32_safe_thresholds) so f32 traversal
+  decisions equal the f64 host reference;
+- raw-score, transformed (sigmoid/softmax, gbdt.py predict) and
+  leaf-index kernels are jit-compiled once per ROW-COUNT BUCKET
+  (powers of two up to max_batch_rows), and warm_up() AOT-compiles
+  every bucket a request can hit at load so no request shape ever
+  traces at request time (the default warms the traversal/leaf kernel
+  all three serving endpoints dispatch; `warm_device_kernels=True`
+  extends that to the all-device f32 variants);
+- the persistent XLA compile cache (config.setup_compilation_cache) is
+  wired in before the first compile, so a warm-process restart loads
+  executables from disk instead of recompiling — sub-second startup.
+
+Precision contract: traversal decisions are exact (the f32 threshold
+cast preserves every f64 `<=` outcome for f32-representable inputs, and
+category ids are exact in f32), so `predict_raw`/`predict` gather the
+traversed leaf indices and reduce in f64 ON HOST — bit-identical to
+GBDT's host predict path (a (B, T) int32 transfer plus a tiny matmul;
+the traversal is the O(depth * B * T) part and stays on device). The
+`_device` variants keep the whole pipeline on device in f32 (reduction
+on the MXU) for throughput-bound callers that tolerate ~1e-6.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import compile_cache_hits, setup_compilation_cache
+from ..models.gbdt import create_boosting, device_traverse, f32_safe_thresholds
+from ..models.tree import Tree
+from ..utils import common
+from ..utils.log import Log
+
+DEFAULT_MAX_BATCH_ROWS = 4096
+
+
+@functools.partial(jax.jit, static_argnums=(7,))
+def _leaf_kernel(xb, sf, thr, cat, lc, rc, node0, depth):
+    """(B, F) f32 rows -> (B, T) int32 leaf indices."""
+    node = device_traverse(xb, sf, thr, cat, lc, rc, node0, depth)
+    return (~node).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(9,))
+def _raw_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot, depth):
+    """(B, F) f32 rows -> (B, K) f32 raw class sums (MXU reduction)."""
+    node = device_traverse(xb, sf, thr, cat, lc, rc, node0, depth)
+    t_idx = jnp.arange(sf.shape[0])
+    vals = lv[t_idx[None, :], ~node]                        # (B, T)
+    return vals @ cls_onehot                                # (B, K)
+
+
+@functools.partial(jax.jit, static_argnums=(9, 10))
+def _transformed_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot,
+                        depth, sigmoid):
+    """(B, F) f32 rows -> (B, K) f32 transformed predictions
+    (gbdt.cpp:622-636 semantics: binary sigmoid / multiclass softmax /
+    raw passthrough)."""
+    raw = _raw_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot,
+                      depth)
+    if sigmoid > 0 and cls_onehot.shape[1] == 1:
+        return 1.0 / (1.0 + jnp.exp(-2.0 * sigmoid * raw))
+    if cls_onehot.shape[1] > 1:
+        return jax.nn.softmax(raw, axis=1)
+    return raw
+
+
+class CompiledPredictor:
+    """A frozen, pre-compiled view of one trained model.
+
+    Build with `from_booster` (a live GBDT/DART/GOSS) or
+    `from_model_file` (the text format). Immutable after construction:
+    later training on the source booster never changes served results.
+    """
+
+    def __init__(self, booster, num_iteration=-1,
+                 max_batch_rows=DEFAULT_MAX_BATCH_ROWS, row_buckets=None,
+                 warmup=True, warm_device_kernels=False):
+        setup_compilation_cache(getattr(booster, "config", None))
+        n_used = booster._num_used_models(num_iteration)
+        self.num_class = max(int(booster.num_class), 1)
+        self.sigmoid = float(booster.sigmoid)
+        self.num_features = int(booster.max_feature_idx) + 1
+        self.num_trees = n_used
+        self.feature_names = list(getattr(booster, "feature_names", []))
+        self.max_batch_rows = int(max_batch_rows)
+        self.buckets = tuple(sorted(set(
+            int(b) for b in (row_buckets or _default_buckets(
+                self.max_batch_rows)))))
+        self.stats = {"warmup_s": 0.0, "compile_cache_hits": 0,
+                      "warm_dispatches": 0, "cold_dispatches": 0,
+                      "buckets": list(self.buckets)}
+        self._warmed = set()
+        if n_used == 0:
+            self.depth = 0
+            return
+        sf, thr, dt, lc, rc, lv, has_split, depth = \
+            booster._stacked_model_arrays(n_used)
+        self.depth = int(depth)
+        # frozen copies: the booster's cache arrays mutate as training
+        # continues; the served model must not
+        self._lv64 = np.array(lv, dtype=np.float64)             # (T, L)
+        onehot = (np.arange(n_used)[:, None] % self.num_class
+                  == np.arange(self.num_class)[None, :])
+        self._onehot64 = onehot.astype(np.float64)              # (T, K)
+        self._dev = (
+            jnp.asarray(np.array(sf)),
+            jnp.asarray(f32_safe_thresholds(thr, dt), jnp.float32),
+            jnp.asarray(np.array(dt) == Tree.CATEGORICAL),
+            jnp.asarray(np.array(lc)),
+            jnp.asarray(np.array(rc)),
+            jnp.asarray(np.where(has_split, 0, ~0).astype(np.int32)),
+        )
+        self._lv32 = jnp.asarray(lv, jnp.float32)
+        self._onehot32 = jnp.asarray(onehot.astype(np.float32))
+        if warmup:
+            self.warm_up(device_kernels=warm_device_kernels)
+
+    # ---------------------------------------------------------- constructors
+    @classmethod
+    def from_booster(cls, booster, num_iteration=-1, **kw):
+        """Freeze a live booster (GBDT/DART/GOSS or a python-API
+        Booster) into a CompiledPredictor."""
+        gbdt = getattr(booster, "gbdt", booster)  # basic.Booster wraps
+        return cls(gbdt, num_iteration=num_iteration, **kw)
+
+    @classmethod
+    def from_model_file(cls, path, num_iteration=-1, **kw):
+        """Load the text model format and freeze it."""
+        booster = create_boosting("gbdt", path)
+        with open(path) as f:
+            booster.load_model_from_string(f.read())
+        return cls(booster, num_iteration=num_iteration, **kw)
+
+    # --------------------------------------------------------------- warmup
+    def warm_up(self, device_kernels=False):
+        """AOT-compile every (kernel, bucket) pair a request can hit so
+        no request shape ever traces at request time. The default warms
+        the traversal/leaf kernel only — predict, predict_raw AND
+        predict_leaf_index all dispatch it (the f64 reduction is host-
+        side); `device_kernels=True` additionally warms the all-device
+        f32 raw/transformed kernels for callers using the `_device`
+        throughput variants. With the persistent compile cache active,
+        a warm-process restart loads executables from disk —
+        `stats["compile_cache_hits"]` counts how many did."""
+        t0 = time.time()
+        hits0 = compile_cache_hits()
+        for b in self.buckets:
+            xb = jnp.zeros((b, self.num_features), jnp.float32)
+            jax.block_until_ready(self._dispatch_leaf(xb))
+            self._warmed.add(("leaf", b))
+            if device_kernels:
+                jax.block_until_ready(self._dispatch_raw32(xb))
+                jax.block_until_ready(self._dispatch_transformed32(xb))
+                self._warmed.update((("raw32", b), ("tr32", b)))
+        self.stats["warmup_s"] = round(time.time() - t0, 3)
+        self.stats["compile_cache_hits"] = compile_cache_hits() - hits0
+        Log.info("CompiledPredictor warm: %d trees, %d buckets (max %d "
+                 "rows) in %.2fs (%d persistent-cache hits)",
+                 self.num_trees, len(self.buckets), self.max_batch_rows,
+                 self.stats["warmup_s"], self.stats["compile_cache_hits"])
+        return self
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch_leaf(self, xb):
+        sf, thr, cat, lc, rc, node0 = self._dev
+        return _leaf_kernel(xb, sf, thr, cat, lc, rc, node0, self.depth)
+
+    def _dispatch_raw32(self, xb):
+        sf, thr, cat, lc, rc, node0 = self._dev
+        return _raw_kernel(xb, sf, thr, cat, lc, rc, self._lv32, node0,
+                           self._onehot32, self.depth)
+
+    def _dispatch_transformed32(self, xb):
+        sf, thr, cat, lc, rc, node0 = self._dev
+        return _transformed_kernel(xb, sf, thr, cat, lc, rc, self._lv32,
+                                   node0, self._onehot32, self.depth,
+                                   self.sigmoid)
+
+    def _canon(self, x):
+        """(N, num_features) f32 view of arbitrary row input: width is
+        CANONICALIZED (narrow pads with 0.0 — absent trailing features,
+        LibSVM-style; wide truncates — no split reads past
+        max_feature_idx) so every dispatch reuses the warmed shapes."""
+        x = np.atleast_2d(np.asarray(x, dtype=np.float32))
+        if x.ndim != 2:
+            raise ValueError(f"rows must be 2-D, got shape {x.shape}")
+        f = x.shape[1]
+        if f < self.num_features:
+            x = np.pad(x, ((0, 0), (0, self.num_features - f)))
+        elif f > self.num_features:
+            x = x[:, :self.num_features]
+        return x
+
+    def _bucket(self, n):
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _blocks(self, x, dispatch, kernel):
+        """Pad-to-bucket dispatch over row blocks; returns the stacked
+        host result. Requests beyond max_batch_rows chunk through the
+        largest bucket (still zero recompilation)."""
+        n = x.shape[0]
+        outs = []
+        top = self.buckets[-1]
+        s = 0
+        while s < n:
+            xb = x[s:s + top]
+            b = self._bucket(xb.shape[0])
+            if (kernel, b) not in self._warmed:  # un-warmed kernel/shape
+                self.stats["cold_dispatches"] += 1
+                self._warmed.add((kernel, b))
+            else:
+                self.stats["warm_dispatches"] += 1
+            pad = b - xb.shape[0]
+            if pad:
+                xb = np.pad(xb, ((0, pad), (0, 0)))
+            outs.append(np.asarray(dispatch(jnp.asarray(xb)))[:b - pad])
+            s += top
+        return np.concatenate(outs, axis=0)
+
+    # ------------------------------------------------------------- predict
+    def predict_leaf_index(self, x):
+        """(N, T) int32 leaf indices (predictor.hpp:108-118)."""
+        x = self._canon(x)
+        if self.num_trees == 0 or x.shape[0] == 0:
+            return np.zeros((x.shape[0], self.num_trees), dtype=np.int32)
+        return self._blocks(x, self._dispatch_leaf, "leaf")
+
+    def predict_raw(self, x):
+        """(N, K) f64 raw scores. Device traversal + host f64 reduction:
+        matches GBDT.predict_raw's host path exactly (module
+        docstring)."""
+        x = self._canon(x)
+        n = x.shape[0]
+        if self.num_trees == 0 or n == 0:
+            return np.zeros((n, self.num_class))
+        leaves = self._blocks(x, self._dispatch_leaf, "leaf")  # (N, T)
+        vals = self._lv64[np.arange(self.num_trees)[None, :], leaves]
+        return vals @ self._onehot64                         # (N, K) f64
+
+    def predict(self, x):
+        """(N, K) f64 transformed predictions (gbdt.py predict:
+        binary sigmoid / multiclass softmax / raw passthrough)."""
+        raw = self.predict_raw(x)
+        if self.sigmoid > 0 and self.num_class == 1:
+            return 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid * raw))
+        if self.num_class > 1:
+            return common.softmax(raw, axis=1)
+        return raw
+
+    def predict_raw_device(self, x):
+        """All-device f32 raw scores (MXU reduction): the throughput
+        path; ~1e-6 of predict_raw."""
+        x = self._canon(x)
+        n = x.shape[0]
+        if self.num_trees == 0 or n == 0:
+            return np.zeros((n, self.num_class))
+        return self._blocks(x, self._dispatch_raw32,
+                            "raw32").astype(np.float64)
+
+    def predict_device(self, x):
+        """All-device f32 transformed predictions; ~1e-6 of predict."""
+        x = self._canon(x)
+        n = x.shape[0]
+        if self.num_trees == 0 or n == 0:
+            return np.zeros((n, self.num_class))
+        return self._blocks(x, self._dispatch_transformed32,
+                            "tr32").astype(np.float64)
+
+    # --------------------------------------------------------------- info
+    def describe(self):
+        """JSON-ready model card for `/healthz`."""
+        return {
+            "num_trees": self.num_trees,
+            "num_class": self.num_class,
+            "num_features": self.num_features,
+            "depth": self.depth,
+            "sigmoid": self.sigmoid,
+            "max_batch_rows": self.max_batch_rows,
+            "buckets": list(self.buckets),
+        }
+
+
+def _default_buckets(max_batch_rows):
+    """Powers of two up to (and including a final bucket covering)
+    max_batch_rows: request row counts round up to one of O(log N)
+    compiled shapes, <= 2x padded-row overhead."""
+    out = []
+    b = 1
+    while b < max_batch_rows:
+        out.append(b)
+        b <<= 1
+    out.append(max_batch_rows)
+    return out

@@ -1,0 +1,224 @@
+"""Serving endpoint: stdlib HTTP server around a CompiledPredictor.
+
+`python -m lightgbm_tpu.serve model.txt --port 8099` loads the text
+model, freezes + AOT-warms it (serving/compiled_model.py), starts the
+micro-batching queue (serving/batcher.py) and serves:
+
+- POST /predict          transformed predictions (sigmoid/softmax)
+- POST /predict_raw      raw scores
+- POST /predict_leaf     leaf indices
+- GET  /healthz          liveness + model card
+- GET  /metricz          request/row/batch counters, batch occupancy,
+                         queue depth, p50/p95/p99 latency, warmup +
+                         compile-cache stats
+
+Request body: JSON `{"rows": [[...], ...]}` (or `{"row": [...]}` for a
+single row), or `text/csv` — one comma/tab-separated row per line.
+Response: JSON `{"predictions": [[...], ...], "rows": N,
+"latency_ms": ...}`.
+
+ThreadingHTTPServer + MicroBatcher is the whole concurrency story:
+each connection's handler thread blocks on its request's Future while
+the single batcher worker coalesces everything that arrived within
+`max_wait_ms` into one padded device dispatch. stdlib-only by design —
+the serving layer must not add dependencies the training image lacks.
+"""
+
+import argparse
+import json
+import signal
+import sys
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..io.parser import NA_VALUES
+from ..utils.log import Log
+from .batcher import MicroBatcher
+from .compiled_model import DEFAULT_MAX_BATCH_ROWS, CompiledPredictor
+from .metrics import ServingMetrics
+
+
+def _parse_rows(body, content_type):
+    """Request body -> (N, F) float32 rows. JSON `rows`/`row` keys, or
+    CSV/TSV lines (NaN/empty cells allowed — they ride the model's
+    missing-value routing)."""
+    if "csv" in (content_type or ""):
+        lines = [ln for ln in body.decode("utf-8").splitlines()
+                 if ln.strip()]
+        sep = "\t" if lines and "\t" in lines[0] else ","
+        na = set(NA_VALUES) | {""}  # the project-wide missing markers
+        rows = [[float(tok) if tok.strip() not in na else float("nan")
+                 for tok in ln.split(sep)]
+                for ln in lines]
+        return np.asarray(rows, dtype=np.float32)
+    payload = json.loads(body)
+    if isinstance(payload, dict):
+        rows = payload.get("rows", payload.get("row"))
+        if rows is None:
+            raise ValueError('JSON body needs a "rows" (or "row") key')
+    else:
+        rows = payload  # bare list-of-lists
+    if rows and not isinstance(rows[0], (list, tuple)):
+        rows = [rows]
+    # JSON null = missing value -> NaN (rides the model's NaN routing)
+    arr = [[float("nan") if v is None else float(v) for v in r]
+           for r in rows]
+    return np.asarray(arr, dtype=np.float32).reshape(len(arr), -1)
+
+
+class ServingHandler(BaseHTTPRequestHandler):
+    """One request per handler-thread; heavy lifting rides the shared
+    batcher."""
+
+    protocol_version = "HTTP/1.1"
+    # set by make_server():
+    batcher = None
+    metrics = None
+    predictor = None
+
+    def log_message(self, fmt, *args):  # route access logs through ours
+        Log.debug("http: " + fmt, *args)
+
+    def _reply(self, code, obj):
+        data = json.dumps(obj).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path.startswith("/healthz"):
+            self._reply(200, {"status": "ok",
+                              "model": self.predictor.describe()})
+        elif self.path.startswith("/metricz"):
+            snap = self.metrics.snapshot()
+            snap["queue_depth"] = self.batcher.queue_depth()
+            stats = self.predictor.stats
+            snap["warmup_s"] = stats["warmup_s"]
+            snap["compile_cache_hits"] = stats["compile_cache_hits"]
+            # True when AOT warmup was served by the persistent compile
+            # cache (warm-process startup; config.py)
+            snap["compile_cache_hit"] = stats["compile_cache_hits"] > 0
+            snap["warm_dispatches"] = stats["warm_dispatches"]
+            snap["cold_dispatches"] = stats["cold_dispatches"]
+            snap["buckets"] = stats["buckets"]
+            self._reply(200, snap)
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        # drain the body BEFORE any reply: on an HTTP/1.1 keep-alive
+        # connection unread body bytes would be parsed as the next
+        # request line, poisoning the client's next call
+        if "chunked" in (self.headers.get("Transfer-Encoding")
+                         or "").lower():
+            self.close_connection = True  # un-drainable without a length
+            self._reply(411, {"error": "chunked bodies not supported; "
+                                       "send Content-Length"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            self.close_connection = True  # length unknown: can't drain
+            self._reply(400, {"error": "malformed Content-Length"})
+            return
+        body = self.rfile.read(length) if length > 0 else b""
+        kind = {"/predict": "predict", "/predict_raw": "raw",
+                "/predict_leaf": "leaf"}.get(self.path.split("?")[0])
+        if kind is None:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        t0 = time.monotonic()
+        try:
+            rows = _parse_rows(body, self.headers.get("Content-Type"))
+            if rows.size == 0:
+                raise ValueError("no rows in request body")
+        except Exception as e:  # malformed request: the CALLER's fault
+            self.metrics.record_error()
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            out = self.batcher.predict(rows, kind=kind, timeout=60.0)
+        except Exception as e:  # dispatch fault/timeout: OUR fault — a
+            self.metrics.record_error()  # 4xx would read as a caller
+            self._reply(500, {"error": str(e)})  # error and stop retries
+            return
+        latency = time.monotonic() - t0
+        self.metrics.record_request(rows.shape[0], latency)
+        self._reply(200, {"predictions": np.asarray(out).tolist(),
+                          "rows": int(rows.shape[0]),
+                          "latency_ms": round(latency * 1e3, 3)})
+
+
+def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
+                max_batch_rows=None):
+    """Wire predictor + batcher + metrics into a ThreadingHTTPServer
+    (not yet serving — call serve_forever, or use it from tests)."""
+    metrics = ServingMetrics()
+    batcher = MicroBatcher(predictor,
+                           max_batch_rows=max_batch_rows,
+                           max_wait_ms=max_wait_ms, metrics=metrics)
+    handler = type("BoundServingHandler", (ServingHandler,),
+                   {"batcher": batcher, "metrics": metrics,
+                    "predictor": predictor})
+    srv = ThreadingHTTPServer((host, port), handler)
+    srv.batcher = batcher
+    srv.metrics = metrics
+    srv.predictor = predictor
+    return srv
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.serve",
+        description="Serve a trained model over HTTP with micro-batching "
+                    "(docs/Serving.md)")
+    ap.add_argument("model", help="model file (text format)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8099)
+    ap.add_argument("--max-batch-rows", type=int,
+                    default=DEFAULT_MAX_BATCH_ROWS,
+                    help="largest coalesced dispatch; also the largest "
+                         "pre-compiled row bucket")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0,
+                    help="how long a lone request waits for company")
+    ap.add_argument("--num-iteration", type=int, default=-1,
+                    help="serve only the first N iterations of the model")
+    args = ap.parse_args(argv)
+
+    t0 = time.time()
+    predictor = CompiledPredictor.from_model_file(
+        args.model, num_iteration=args.num_iteration,
+        max_batch_rows=args.max_batch_rows)
+    srv = make_server(predictor, host=args.host, port=args.port,
+                      max_wait_ms=args.max_wait_ms,
+                      max_batch_rows=args.max_batch_rows)
+    Log.info("serving %s on http://%s:%d (%d trees, load+warm %.2fs, "
+             "%d compile-cache hits)", args.model, args.host, args.port,
+             predictor.num_trees, time.time() - t0,
+             predictor.stats["compile_cache_hits"])
+    # the driver-facing readiness line: tests and orchestrators wait
+    # for this exact prefix on stdout before sending traffic
+    print(f"SERVING http://{args.host}:{srv.server_address[1]}",
+          flush=True)
+
+    def shut(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, shut)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+        srv.batcher.close()
+        Log.info("serving stopped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
