@@ -46,7 +46,17 @@ verify-serve:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_serving.py -q
 
+# observability suite: span tracer nesting/isolation, registry
+# thread-safety, journal atomicity across hard kills, multi-rank merge,
+# /trainz endpoint, /metricz parity — then the journal-schema lint on a
+# freshly generated journal (tools/check_journal.py --demo trains a
+# tiny run with telemetry on and validates every record)
+verify-obs:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_telemetry.py -q
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_journal.py --demo
+
 clean:
 	rm -f $(TARGET)
 
-.PHONY: all test-capi verify-fault verify-dist verify-serve clean
+.PHONY: all test-capi verify-fault verify-dist verify-serve verify-obs clean

@@ -37,10 +37,14 @@ Output: each printed line is a complete result JSON
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 vs_baseline > 1 means faster than the reference. Parsers taking the
 LAST JSON line get the richest result; the FIRST is already complete.
-The `phases` dict carries the host-timed compile phase, per-op
-microprobe timings (`hist`/`split`/`score_update`, seconds per call —
-see phase_probe) and `compile_cache_hit` (1.0 when the persistent
-compile cache served the fused program's lowering). The `serving`
+The `phases` dict is reconstructed from the structured run journal
+(telemetry/journal.py; training runs with `telemetry=true` and the
+per-record phase deltas sum back to the run totals), then extended
+with per-op microprobe timings (`hist`/`split`/`score_update`, seconds
+per call — see phase_probe), `compile_cache_hit` (1.0 when the
+persistent compile cache served the fused program's lowering), and
+`telemetry_overhead_pct` (the telemetry stack's own projected cost,
+bar <1% — see telemetry_probe). The `serving`
 dict (serving_probe) carries the online-inference trajectory:
 `serving.latency_p50_ms` (warm single-row) and
 `serving.throughput_rows_s` (sustained batched) vs the predict_raw
@@ -246,12 +250,20 @@ def _mark(msg):
 
 
 def train_once(n_rows, n_iters=NUM_ITERATIONS):
+    import tempfile
+
     from lightgbm_tpu.config import Config
     from lightgbm_tpu.io.dataset import DatasetLoader
     from lightgbm_tpu.metrics import create_metric
     from lightgbm_tpu.models.gbdt import GBDT
     from lightgbm_tpu.objectives import create_objective
 
+    # the bench runs with telemetry ON: the `phases` dict is
+    # reconstructed from the structured run journal instead of the old
+    # hand-rolled timers dict, which also proves the journal's records
+    # sum back to the run totals (docs/Observability.md); the
+    # telemetry_probe below prices the instrumentation itself
+    telemetry_dir = tempfile.mkdtemp(prefix="bench_journal_")
     params = {
         "objective": "binary",
         "num_leaves": 63,
@@ -260,6 +272,8 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
         "num_iterations": n_iters,
         "metric": "auc",
         "metric_freq": 0,  # no eval inside the timed loop
+        "telemetry": "true",
+        "telemetry_dir": telemetry_dir,
         # engine selection mirrors the shipped defaults: "auto" runs the
         # leaf-contiguous builder on TPU and the gather-compacted dense
         # builder elsewhere (docs/Histogram-Engine.md);
@@ -303,13 +317,12 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     # builder with one training round and roll it back so the timed model
     # has exactly n_iters trees (AUC comparable to the baseline)
     _mark(f"compiling fused {block}-iteration program")
-    from lightgbm_tpu.utils.timers import TIMERS
-    TIMERS.reset()
+    booster.tracer.reset()  # per-Booster tracer (telemetry/trace.py)
     t0 = time.time()
     if not booster.warm_up_fused(block):
         booster.train_one_iter(is_eval=False)
         booster.rollback_one_iter()
-    TIMERS.add("compile", time.time() - t0)
+    booster.tracer.add("compile", time.time() - t0)
     _mark("compile done, starting timed loop")
 
     t0 = time.time()
@@ -325,15 +338,89 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     auc_metric = create_metric("auc", cfg)
     auc_metric.init(ds.metadata, ds.num_data)
     auc = float(auc_metric.eval(booster.get_training_score())[0])
-    phases = TIMERS.snapshot()
+    phases = journal_phases(booster)
+    if not phases:  # journal disabled/unwritable: tracer totals directly
+        phases = booster.tracer.snapshot()
     _mark("probing per-op phase timings")
     phases.update({k: round(v, 6) for k, v in phase_probe(booster).items()})
     phases.update(checkpoint_probe(booster, train_s))
     phases.update(supervisor_probe())
+    phases.update(telemetry_probe(booster, train_s, n_iters))
+    # the journal has been read into `phases`; don't leak its temp dir
+    import shutil
+    booster.close_telemetry()
+    shutil.rmtree(telemetry_dir, ignore_errors=True)
     # 1.0 = the fused program's lowering was served by the persistent
     # compile cache (config.py setup_compilation_cache)
     phases["compile_cache_hit"] = float(booster.last_compile_cache_hit)
     return train_s, auc, booster, load_s, phases, x
+
+
+def journal_phases(booster):
+    """Reconstruct the per-phase seconds breakdown from the run
+    journal's iteration records (each carries phase DELTAS, so the sum
+    over records is the run total — the property the telemetry suite
+    pins). Returns {} when no journal is active."""
+    if booster.journal is None:
+        return {}
+    from lightgbm_tpu.telemetry.journal import read_journal
+    records, bad = read_journal(booster.journal.path)
+    if bad:
+        _mark(f"journal has {bad} torn line(s)")
+    phases, n_records = {}, 0
+    for rec in records:
+        if rec.get("event") != "iteration":
+            continue
+        n_records += 1
+        for name, secs in (rec.get("phases") or {}).items():
+            if isinstance(secs, (int, float)):
+                phases[name] = phases.get(name, 0.0) + secs
+    phases = {k: round(v, 6) for k, v in phases.items()}
+    if n_records:
+        phases["journal_records"] = float(n_records)
+    return phases
+
+
+def telemetry_probe(booster, train_s, n_iters):
+    """Price the telemetry stack itself: one per-iteration emission
+    (tracer span + registry updates + one journal record into a
+    throwaway journal, so the run's real journal stays clean), median-
+    of-3 over 200 reps. `telemetry_overhead_pct` projects that cost
+    over the run's iteration count as a percentage of measured train
+    time — the acceptance bar is <1% with journal+registry on."""
+    import shutil
+    import tempfile
+
+    from lightgbm_tpu.telemetry.journal import RunJournal
+
+    out = {}
+    d = tempfile.mkdtemp(prefix="bench_telemetry_")
+    try:
+        probe_journal = RunJournal(d, rank=0, emit_run_start=False)
+        reps = 200
+        trials = []
+        for _ in range(3):
+            t0 = time.time()
+            for _ in range(reps):
+                with booster.tracer.phase("telemetry_probe"):
+                    pass
+                booster.metrics.inc("telemetry_probe_count")
+                booster.metrics.observe("telemetry_probe_s", 0.001)
+                probe_journal.iteration(
+                    0, phases={"probe": 0.001}, grad_norm=0.5,
+                    hess_norm=0.5, leaf_count=63)
+            trials.append((time.time() - t0) / reps)
+        probe_journal.close()
+        per_iter_s = sorted(trials)[1]
+        out["telemetry_record_s"] = round(per_iter_s, 9)
+        if train_s > 0 and n_iters > 0:
+            out["telemetry_overhead_pct"] = round(
+                100.0 * per_iter_s * n_iters / train_s, 6)
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"telemetry probe failed: {e}")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return out
 
 
 def phase_probe(booster):
@@ -580,9 +667,14 @@ def run_child():
     train_s, auc, booster, load_s, phases, x_raw = train_once(n_rows, n_iters)
     # the TRAIN result prints FIRST: the optional predict timing below
     # must not be able to cost us the primary measurement (watchdog)
+    learner = booster.tree_learner
+    hist_mode = ("partitioned" if getattr(learner, "_use_partitioned", False)
+                 else "compacted" if getattr(learner, "_use_compact", False)
+                 else "masked")
     res = {"time_s": round(train_s, 3), "auc": round(auc, 5),
            "n_rows": n_rows, "n_iters": n_iters, "load_s": round(load_s, 3),
            "platform": jax.devices()[0].platform,
+           "hist_mode": hist_mode,
            "phases": phases}
     # a full boosting iteration at >=100k rows cannot run in <1 ms; a
     # smaller number means the tunnel served a memoized dispatch
@@ -759,6 +851,8 @@ def _format_result(res, reason):
         result["vs_baseline"] = 0.0
     if "load_s" in res:
         result["load_s"] = res["load_s"]
+    if "hist_mode" in res:
+        result["hist_mode"] = res["hist_mode"]
     if "predict_s" in res:
         result["predict_s"] = res["predict_s"]
     if "error" in res:

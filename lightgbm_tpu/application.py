@@ -129,6 +129,12 @@ class Application:
     # -------------------------------------------------------------- training
     def init_train(self):
         cfg = self.config
+        if cfg.telemetry and not cfg.telemetry_dir:
+            # default the journal next to the other shared run state
+            # (heartbeats, snapshots, restart barrier) so the whole
+            # run's timeline lives in one directory
+            cfg.telemetry_dir = (cfg.snapshot_dir
+                                 or cfg.output_model + ".snapshots")
         if cfg.is_parallel:
             # multi-host membership (the reference's Network::Init TCP
             # handshake, application.cpp:189) -> jax.distributed
@@ -193,8 +199,8 @@ class Application:
         model of an uninterrupted run. The fused paths clamp their
         block size to the snapshot cadence so snapshots land on block
         boundaries."""
-        from .utils.timers import TIMERS
         cfg = self.config
+        tracer = self.boosting.tracer  # per-Booster (telemetry/trace.py)
         import jax
         from .parallel import heartbeat
         # shared scratch dir: snapshots, heartbeats, watchdog markers,
@@ -242,6 +248,9 @@ class Application:
                               sorted(int(v) for v in found), snap_dir)
             if state is not None:
                 self.boosting.restore_training_state(state)
+                if self.boosting.journal is not None:
+                    self.boosting.journal.event(
+                        "resume", iteration=int(self.boosting.iter))
             if jax.process_count() > 1:
                 # every rank must restore the SAME iteration: a rank
                 # that cannot see the snapshot dir would cold-start and
@@ -268,10 +277,20 @@ class Application:
             # multi-host row-sharded capture is COLLECTIVE (the global
             # train score is allgathered, models/gbdt.py), so every
             # rank captures at the cadence point; only rank 0 writes
+            # timed from capture (device sync + transfer) through the
+            # atomic write, matching callback._Checkpoint.save_now so
+            # `checkpoint_write_s` is one comparable quantity everywhere
+            t0 = time.time()
             state = b.capture_training_state()
             if manager is not None:
                 path = manager.save(state, b.iter)
+                write_s = time.time() - t0
                 heartbeat.notify_checkpoint(b.iter, path)
+                b.metrics.observe("checkpoint_write_s", write_s)
+                if b.journal is not None:
+                    b.journal.event("checkpoint", iteration=int(b.iter),
+                                    path=str(path),
+                                    write_s=round(write_s, 6))
             if jax.process_count() > 1:
                 # hold every rank HERE while rank 0 writes, under a
                 # guard that NAMES the snapshot barrier: otherwise the
@@ -294,7 +313,7 @@ class Application:
             b = self.boosting
             boundary = ((b.iter // cfg.snapshot_freq) + 1) * cfg.snapshot_freq
             return min(step, max(1, boundary - b.iter))
-        TIMERS.reset()
+        tracer.reset()
         trace_dir = None
         if cfg.profile:
             import jax
@@ -377,14 +396,30 @@ class Application:
                 import jax
                 jax.profiler.stop_trace()
                 Log.info("Wrote jax.profiler trace to %s", trace_dir)
-        if TIMERS.acc:
-            Log.debug("Per-phase timers:\n%s", TIMERS.report())
+        if tracer.acc:
+            Log.debug("Per-phase timers:\n%s", tracer.report())
         import jax
         if jax.process_index() == 0:  # every rank has the identical model
             self.boosting.save_model_to_file(-1, cfg.output_model)
+        b = self.boosting
+        if b.journal is not None:
+            b.journal.event("run_end", iterations=int(b.iter),
+                            train_s=round(time.time() - start, 3))
+            if jax.process_count() > 1:
+                # hold every rank here until all run_end records are on
+                # shared storage — without it rank 0's merge below
+                # could permanently miss a straggling peer's tail
+                from jax.experimental import multihost_utils
+                with heartbeat.collective_guard("journal_merge_barrier"):
+                    multihost_utils.process_allgather(
+                        np.asarray([b.iter], dtype=np.int64))
         # final `done` beat + monitor stop: a cleanly finished rank must
         # never be declared dead by peers still tearing down
         heartbeat.shutdown(done=True)
+        # rank 0 merges every rank's journal into one wall-time-sorted
+        # timeline (journal.jsonl); peers that aborted in an earlier
+        # incarnation left their abort records in the same rank files
+        b.close_telemetry(merge=jax.process_index() == 0)
         Log.info("Finished training")
 
     # ------------------------------------------------------------ prediction

@@ -222,15 +222,26 @@ class _Checkpoint:
         """Snapshot the booster's CURRENT state, keyed by its own
         completed-iteration count (independent of any init_model
         offset)."""
+        import time
+        t0 = time.time()
         state = booster.gbdt.capture_training_state()
         state["booster_attrs"] = dict(booster._attr)
         state["callback_states"] = [
             (type(cb).__name__, cb.state_dict()) for cb in self._peers]
         self.last_saved_path = self.manager.save(state, booster.gbdt.iter)
+        write_s = time.time() - t0
         # supervisor heartbeats advertise the newest resumable snapshot
         # (parallel/heartbeat.py); no-op when no service is running
         from .parallel import heartbeat
         heartbeat.notify_checkpoint(booster.gbdt.iter, self.last_saved_path)
+        # checkpoint write latency: registry histogram + journal event
+        # (telemetry/; both no-ops shrink to dict lookups when off)
+        gbdt = booster.gbdt
+        gbdt.metrics.observe("checkpoint_write_s", write_s)
+        if gbdt.journal is not None:
+            gbdt.journal.event("checkpoint", iteration=int(gbdt.iter),
+                               path=str(self.last_saved_path),
+                               write_s=round(write_s, 6))
         return self.last_saved_path
 
     def restore_into(self, booster, state, all_callbacks):

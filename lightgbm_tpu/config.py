@@ -245,6 +245,22 @@ class Config:
     restart_on_failure: bool = True
     max_restarts: int = 2
 
+    # --- telemetry (lightgbm_tpu/telemetry/; no reference equivalent) ---
+    # master switch for the structured run journal (+ /trainz wiring);
+    # span tracing and the metrics registry are always on — in-memory
+    # and near-free (docs/Observability.md)
+    telemetry: bool = False
+    # journal directory (rank-suffixed JSONL files, rank 0 merges); the
+    # CLI defaults it to the shared run dir (snapshot_dir, else
+    # <output_model>.snapshots) so aborts/restarts/resumes land in the
+    # same timeline as training progress
+    telemetry_dir: str = ""
+    # >0 serves the live GET /trainz endpoint on 127.0.0.1:<port>
+    telemetry_port: int = 0
+    # wrap tracer spans in jax.profiler.TraceAnnotation so host-side
+    # phases line up with XLA device traces (`profile=1` workflow)
+    telemetry_jax_annotations: bool = False
+
     # --- fault tolerance (utils/checkpoint.py; no reference equivalent) ---
     snapshot_freq: int = 0     # checkpoint every k iterations (0 = off)
     snapshot_dir: str = ""     # default: <output_model>.snapshots
@@ -430,6 +446,7 @@ class Config:
         check(self.collective_timeout_s >= 0,
               "collective_timeout_s should be >= 0")
         check(self.max_restarts >= 0, "max_restarts should be >= 0")
+        check(self.telemetry_port >= 0, "telemetry_port should be >= 0")
         check(self.max_bad_rows >= 0, "max_bad_rows should be >= 0")
         check(self.device_predict_cells > 0,
               "device_predict_cells should be > 0")

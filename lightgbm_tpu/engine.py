@@ -239,6 +239,11 @@ def train(params, train_set, num_boost_round=100,
                 else callback._Checkpoint(manager, 0)
             restorer.restore_into(booster, state, all_cbs)
             start_offset = min(booster.gbdt.iter, num_boost_round)
+            if booster.gbdt.journal is not None:
+                # the restart lands in the run journal's timeline next
+                # to the abort that caused it (docs/Observability.md)
+                booster.gbdt.journal.event(
+                    "resume", iteration=int(booster.gbdt.iter))
 
     # fast path: nothing needs the per-round boundary (no callbacks, no
     # custom objective, no valid evaluation) — run the whole block as

@@ -23,9 +23,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel import heartbeat
+from ..telemetry import journal as run_journal
+from ..telemetry.registry import MetricsRegistry
+from ..telemetry.trace import SpanTracer
 from ..utils import common, faults, guardrails
 from ..utils.log import Log
-from ..utils.timers import TIMERS
 from .score_updater import ScoreUpdater
 from .tree import Tree
 from .tree_learner import create_tree_learner
@@ -265,7 +267,12 @@ class _BlockSnapshots:
             n_drop += self._k_stop
         if n_drop > 0:
             del gb.models[-n_drop:]
-        gb.iter -= self._t_eff - (t + 1)
+        dropped = self._t_eff - (t + 1)
+        gb.iter -= dropped
+        if gb.journal is not None and dropped > 0:
+            gb.journal.event("truncate", iteration=int(gb.iter),
+                             dropped_iters=int(dropped),
+                             reason="early_stop_block")
 
     def set_scores_at(self, t, with_train=False):
         """Point every bound updater's score at the post-iteration-t
@@ -336,6 +343,13 @@ class GBDT:
         self._bag_window = None     # it // bagging_freq of the cached bag
         self.last_compile_cache_hit = False  # persistent-cache hit on
         #                             the latest fused-program lowering
+        # per-Booster telemetry (telemetry/): the tracer replaces the
+        # old utils/timers.py process-global singleton, whose
+        # accumulator two Boosters in one process silently shared
+        self.tracer = SpanTracer()
+        self.metrics = MetricsRegistry()
+        self.journal = None         # RunJournal when `telemetry` is on
+        self._trainz_server = None
 
     # ------------------------------------------------------------------ init
     def init(self, config, train_data, objective, training_metrics=()):
@@ -388,6 +402,11 @@ class GBDT:
         # data_changed already init'ed the learner with this config
         if self.tree_learner is not None and not data_changed:
             self.tree_learner.reset_config(config)
+        if self.tree_learner is not None:
+            # learners account host<->device transfer bytes into the
+            # booster's registry (parallel/learners.py)
+            self.tree_learner.metrics = self.metrics
+        self._setup_telemetry(config)
 
     def add_valid_dataset(self, valid_data, valid_metrics):
         """gbdt.cpp:117-147."""
@@ -407,6 +426,92 @@ class GBDT:
             self.best_iter.append([0] * len(valid_metrics))
             self.best_score.append([K_MIN_SCORE] * len(valid_metrics))
             self.best_msg.append([""] * len(valid_metrics))
+
+    # ------------------------------------------------------------- telemetry
+    def _setup_telemetry(self, config):
+        """Wire the `telemetry_*` knobs (docs/Observability.md): span ->
+        jax.profiler annotation passthrough, the structured run journal
+        (rank-suffixed JSONL in `telemetry_dir`), the collective
+        sync-wait timing sink, and the opt-in /trainz endpoint.
+        Idempotent per booster — a reset_parameter() config rebuild must
+        not open a second journal."""
+        self.tracer.jax_annotations = bool(
+            getattr(config, "telemetry_jax_annotations", False))
+        if not getattr(config, "telemetry", False):
+            return
+        import weakref
+        ref = weakref.ref(self)  # process-global sinks and the /trainz
+        #                          thread must not pin a dropped booster
+
+        def timing_sink(name, seconds):
+            gbdt = ref()
+            if gbdt is not None:
+                gbdt.metrics.observe("sync_wait_s", seconds)
+
+        # collective sync-wait seconds land in the registry whenever the
+        # watchdog is armed (parallel/heartbeat.py; the armed section is
+        # the measurement, so an unarmed watchdog stays zero-overhead)
+        heartbeat.bind_timing_sink(timing_sink)
+        if self.journal is None:
+            directory = (getattr(config, "telemetry_dir", "")
+                         or getattr(config, "snapshot_dir", ""))
+            if not directory:
+                Log.warning("telemetry=true but neither telemetry_dir "
+                            "nor snapshot_dir is set; run journal "
+                            "disabled")
+            else:
+                rank = faults.current_rank()
+                self.journal = run_journal.RunJournal(
+                    directory, rank=rank,
+                    meta={"num_ranks": int(getattr(config, "num_machines",
+                                                   1) or 1)})
+                run_journal.set_current(self.journal)
+                self.tracer.rank = rank
+        port = int(getattr(config, "telemetry_port", 0) or 0)
+        if port > 0 and self._trainz_server is None:
+            from ..telemetry import trainz
+
+            def iteration_fn():
+                gbdt = ref()
+                return gbdt.iter if gbdt is not None else -1
+
+            self._trainz_server = trainz.start_trainz(
+                trainz.build_sources(iteration_fn=iteration_fn,
+                                     tracer=self.tracer,
+                                     registry=self.metrics,
+                                     journal=self.journal),
+                port=port)
+
+    def _journal_iteration(self, **fields):
+        """One journal record per completed iteration (or fused block —
+        `block` carries the iteration count it covers); phase seconds
+        ride as deltas so summing records reconstructs the run totals
+        (bench.py)."""
+        if self.journal is None:
+            return
+        self.journal.iteration(self.iter,
+                               phases=self.tracer.delta_snapshot(),
+                               **fields)
+
+    @staticmethod
+    def _rms(arr):
+        a = np.asarray(arr, dtype=np.float64)
+        return float(np.sqrt(np.mean(a * a))) if a.size else 0.0
+
+    def close_telemetry(self, merge=False):
+        """End-of-run hook: close the journal (after an optional rank-0
+        merge) and stop the /trainz thread. Safe to call twice."""
+        if self.journal is not None:
+            if merge:
+                run_journal.merge_journals(self.journal.directory)
+            self.journal.close()
+            if run_journal.current() is self.journal:
+                run_journal.set_current(None)
+            self.journal = None
+        if self._trainz_server is not None:
+            from ..telemetry import trainz
+            trainz.stop_trainz(self._trainz_server)
+            self._trainz_server = None
 
     # --------------------------------------------------------------- bagging
     def _bagging_device_fn(self):
@@ -484,7 +589,7 @@ class GBDT:
         if gradients is None or hessians is None:
             if self.objective is None:
                 Log.fatal("No object function provided")
-            with TIMERS.phase("gradients"):
+            with self.tracer.phase("gradients"):
                 gradients, hessians = self.objective.get_gradients(
                     self._score_for_boosting())
         else:
@@ -505,14 +610,16 @@ class GBDT:
                 # persistently-poisoned objective stalls progress but
                 # cannot loop forever.
                 return False
-        with TIMERS.phase("bagging"):
+        with self.tracer.phase("bagging"):
             inbag = self._bagging(self.iter, gradients, hessians)
         n = self.num_data
         multi_host = getattr(self.tree_learner, "n_proc", 1) > 1
+        new_leaves = 0
         for k in range(self.num_class):
-            with TIMERS.phase("build"):
+            with self.tracer.phase("build"):
                 out = self.tree_learner.train_device(
                     gradients[k], hessians[k], inbag)
+            self.metrics.inc("tree_build_dispatches")
             # enqueue ALL device work for this class before the scalar stop
             # check: train scores via partition gather (covers in-bag AND
             # out-of-bag rows: the partition is computed over all rows, the
@@ -521,7 +628,7 @@ class GBDT:
             # every update a no-op (leaf values are all zero), so checking
             # afterwards is safe.
             tree = LazyTree(out, self.tree_learner, shrink=self.shrinkage_rate)
-            with TIMERS.phase("score_upd"):
+            with self.tracer.phase("score_upd"):
                 self.train_score_updater.add_score_by_partition(
                     self.tree_learner.local_leaf_values(out) * self.shrinkage_rate,
                     self.tree_learner.local_row_leaf(out, n), k)
@@ -533,17 +640,27 @@ class GBDT:
                     else:
                         updater.add_score_by_device_tree(
                             out, self.shrinkage_rate, k)
-            with TIMERS.phase("host_sync"), \
+            with self.tracer.phase("host_sync"), \
                     heartbeat.collective_guard("leaf_count_sync"):
                 stopped = tree.num_leaves <= 1  # scalar sync: the only wait
             if stopped:
                 Log.info("Stopped training because there are no more leafs "
                          "that meet the split requirements.")
                 return True
+            new_leaves += tree.num_leaves
             self.models.append(tree)
         self.iter += 1
+        self.metrics.inc("leaves_total", new_leaves)
+        self.metrics.set("iteration", self.iter)
+        if self.journal is not None:
+            # norms are the per-iteration training-health proxy (a NaN
+            # storm or divergence is visible before the guardrails
+            # fire); np transfer is (K, N) f32, telemetry-gated
+            self._journal_iteration(grad_norm=self._rms(gradients),
+                                    hess_norm=self._rms(hessians),
+                                    leaf_count=int(new_leaves))
         if is_eval:
-            with TIMERS.phase("eval"):
+            with self.tracer.phase("eval"):
                 return self.eval_and_check_early_stopping()
         return False
 
@@ -698,6 +815,10 @@ class GBDT:
         # whether the persistent compile cache served this lowering —
         # surfaced by bench.py as phases.compile_cache_hit
         self.last_compile_cache_hit = compile_cache_hits() > hits_before
+        if self.last_compile_cache_hit:
+            # counted HERE, once per actual lowering — blocks reusing
+            # the in-process runner never touch the persistent cache
+            self.metrics.inc("compile_cache_hits")
 
         def runner(score, fmasks, iters):
             return compiled(score, fmasks, iters, data)
@@ -740,7 +861,8 @@ class GBDT:
         # the whole block is one device program; its host-side waits
         # (score pull, stacked-tree transfer) are THE block-boundary
         # sync points the collective watchdog brackets
-        with heartbeat.collective_guard("fused_block"):
+        with self.tracer.phase("fused_block", iterations=num_iters), \
+                heartbeat.collective_guard("fused_block"):
             final_score, stacked = fn(self.train_score_updater.score,
                                       fmasks, iters)
             self.train_score_updater.score = final_score
@@ -776,6 +898,17 @@ class GBDT:
                 self.models.append(learner.host_out_to_tree(
                     slice_at(t_eff, k), shrink=self.shrinkage_rate))
         self.iter += t_eff
+        self.metrics.inc("tree_build_dispatches",
+                         len(self.models) - n_before)
+        self.metrics.inc("transfer_bytes",
+                         sum(np.asarray(v).nbytes for v in host.values()))
+        self.metrics.set("iteration", self.iter)
+        if self.journal is not None and t_eff > 0:
+            # per-iteration host phases do not exist inside one XLA
+            # program: the block record covers its t_eff iterations
+            self._journal_iteration(
+                block=int(t_eff), fused=True,
+                compile_cache_hit=bool(self.last_compile_cache_hit))
         return stacked, t_eff, k_stop, n_before
 
     def _natural_stop_score_exact(self):
@@ -902,6 +1035,9 @@ class GBDT:
         self.iter -= k
         for updater in [self.train_score_updater] + self.valid_score_updaters:
             updater.sub_score_by_trees(dropped, self.num_class)
+        if self.journal is not None:
+            self.journal.event("truncate", iteration=int(self.iter),
+                               dropped_iters=int(k), reason="early_stop")
 
     def output_metric(self, it):
         """gbdt.cpp:292-349: print metrics, track early stopping."""
@@ -910,12 +1046,14 @@ class GBDT:
         ret = ""
         msg_lines = []
         met_pairs = []
+        met_values = {}
         if need_output:
             for metric in self.training_metrics:
                 scores = metric.eval(self.train_score_updater.host_score())
                 for name, sc in zip(metric.names, scores):
                     line = f"Iteration:{it}, training {name} : {sc:g}"
                     Log.info("%s", line)
+                    met_values[f"training {name}"] = float(sc)
                     if self.early_stopping_round > 0:
                         msg_lines.append(line)
         if need_output or self.early_stopping_round > 0:
@@ -924,6 +1062,7 @@ class GBDT:
                     scores = metric.eval(self.valid_score_updaters[i].host_score())
                     for name, sc in zip(metric.names, scores):
                         line = f"Iteration:{it}, valid_{i + 1} {name} : {sc:g}"
+                        met_values[f"valid_{i + 1} {name}"] = float(sc)
                         if need_output:
                             Log.info("%s", line)
                         if self.early_stopping_round > 0:
@@ -939,6 +1078,11 @@ class GBDT:
         msg = "\n".join(msg_lines)
         for i, j in met_pairs:
             self.best_msg[i][j] = msg
+        if self.journal is not None and met_values:
+            # metric values (train loss/AUC/...) in the same timeline as
+            # the iteration records they describe
+            self.journal.event("metrics", iteration=int(it),
+                               values=met_values)
         return ret
 
     def get_eval_at(self, data_idx):

@@ -133,6 +133,7 @@ def init_from_config(config):
                   rank, config.num_machines, config.machine_list_file,
                   len(machines))
     faults.set_rank(rank)  # rank-targeted fault injection + heartbeats
+    Log.set_rank(rank)     # rank-attributable interleaved child logs
     coordinator = f"{machines[0][0]}:{machines[0][1]}"
     # CPU multi-process collectives need an explicit implementation
     # (the default CPU client refuses cross-process computations with

@@ -131,6 +131,10 @@ class CollectiveWatchdog:
                 {"rank": self.rank, "collective": name,
                  "iteration": iteration, "timeout_s": self.timeout_s,
                  "time": time.time()})
+        # the abort lands in the run journal's timeline (exit 117 and
+        # the later restart/resume tell one story; telemetry/journal.py)
+        _journal_abort(EXIT_WATCHDOG, "collective_watchdog",
+                       collective=name, iteration=int(iteration))
         if self.on_expire is not None:
             self.on_expire(name, iteration)
             return
@@ -153,6 +157,11 @@ class CollectiveWatchdog:
             elapsed = time.monotonic() - start
             self.timings[name] = elapsed
             self.last_sync_s = elapsed
+            if _TIMING_SINK is not None:
+                try:
+                    _TIMING_SINK(name, elapsed)
+                except Exception:   # telemetry must never kill training
+                    pass
 
 
 class HeartbeatService:
@@ -289,6 +298,8 @@ class HeartbeatService:
                 "state: %s",
                 dead, ", ".join(f"{ages[r]:.1f}s" for r in dead),
                 self.timeout_s, report or "n/a")
+            _journal_abort(EXIT_PEER_LOST, "peer_lost",
+                           dead_ranks=[int(r) for r in dead])
             if self.on_peer_lost is not None:
                 self.on_peer_lost(dead)
             else:
@@ -334,6 +345,30 @@ class HeartbeatService:
 
 WATCHDOG = CollectiveWatchdog(0.0)
 _SERVICE = None
+_TIMING_SINK = None   # (collective_name, elapsed_s) -> None; telemetry
+
+
+def bind_timing_sink(fn):
+    """Route every armed section's elapsed time into a telemetry sink
+    (the booster's metrics registry observes `sync_wait_s`); None
+    unbinds. Only armed sections measure, so an unarmed watchdog stays
+    zero-overhead."""
+    global _TIMING_SINK
+    _TIMING_SINK = fn
+
+
+def _journal_abort(exit_code, reason, **fields):
+    """Best-effort abort record into the active run journal (no-op
+    without one). The journal write is a single O_APPEND line, safe to
+    issue from the watchdog/monitor threads right before os._exit."""
+    try:
+        from ..telemetry import journal as run_journal
+        j = run_journal.current()
+        if j is not None:
+            j.event("abort", exit_code=int(exit_code), reason=reason,
+                    **fields)
+    except Exception:   # telemetry must never mask the abort itself
+        pass
 
 
 def collective_guard(name):
@@ -392,3 +427,4 @@ def shutdown(done=True):
         _SERVICE.stop(done=done)
         _SERVICE = None
     WATCHDOG.timeout_s = 0.0
+    bind_timing_sink(None)   # drop the telemetry sink's booster ref

@@ -281,15 +281,27 @@ class _MeshedTreeLearner(SerialTreeLearner):
                             key=lambda s: s.index[0].start)
             # shards are committed to distinct local devices; assemble
             # on host
-            return np.concatenate(
+            local = np.concatenate(
                 [np.asarray(s.data) for s in shards])[:n_local]
+        self._account_transfer(local.nbytes)
+        return local
 
     def local_leaf_values(self, out):
         """Fully-replicated global -> local array (multi-host)."""
         if self.n_proc == 1:
             return out["leaf_value"]
         with collective_guard(f"{self.name}:leaf_value_fetch"):
-            return jnp.asarray(jax.device_get(out["leaf_value"]))
+            host = jax.device_get(out["leaf_value"])
+        self._account_transfer(np.asarray(host).nbytes)
+        return jnp.asarray(host)
+
+    def _account_transfer(self, nbytes):
+        """Device->host bytes pulled at this learner's sync points,
+        counted into the owning booster's metrics registry (`metrics`
+        is bound by GBDT.reset_training_data; telemetry/registry.py)."""
+        m = getattr(self, "metrics", None)
+        if m is not None:
+            m.inc("transfer_bytes", int(nbytes))
 
     def _out_specs(self):
         specs = {k: P() for k in _TREE_OUT_KEYS}

@@ -1,10 +1,13 @@
 """Serving-side metrics: counters, batch occupancy, latency ring.
 
 No reference equivalent — the reference predictor is a library call
-(predictor.hpp); a standing service needs its own accounting. All
-methods are thread-safe (the HTTP handler pool and the batcher worker
-update concurrently) and snapshot() is what `/metricz` serializes
-(serving/server.py).
+(predictor.hpp); a standing service needs its own accounting. Built on
+the telemetry registry primitives (telemetry/registry.py: the
+training-side metrics share the same lock discipline and ring-
+percentile semantics — this module used to carry its own copies of
+both). All methods are thread-safe (the HTTP handler pool and the
+batcher worker update concurrently) and snapshot() is what `/metricz`
+serializes (serving/server.py).
 
 Latency percentiles come from a fixed-size ring buffer of the most
 recent request latencies: O(1) record, O(ring log ring) on read, and a
@@ -12,84 +15,101 @@ bounded-memory view that tracks the CURRENT tail behavior instead of
 averaging over the process lifetime.
 """
 
-import threading
 import time
 
-import numpy as np
+from ..telemetry.registry import MetricsRegistry
 
 RING_SIZE = 4096
 
 
 class ServingMetrics:
     """Request/row/batch counters + latency ring for one serving
-    process."""
+    process. The legacy attribute surface (`request_count`, ...) is
+    kept as properties over the registry instruments."""
 
     def __init__(self, ring_size=RING_SIZE):
-        self._lock = threading.Lock()
-        self._ring = np.zeros(int(ring_size), dtype=np.float64)
-        self._ring_n = 0          # total latencies ever recorded
+        self.registry = MetricsRegistry()
+        self._requests = self.registry.counter("request_count")
+        self._rows = self.registry.counter("rows_served")
+        self._batches = self.registry.counter("batch_count")
+        self._batched_rows = self.registry.counter("batched_rows")
+        self._batched_requests = self.registry.counter("batched_requests")
+        self._errors = self.registry.counter("error_count")
+        self._latency = self.registry.histogram("latency_ms", ring_size)
         self.started_at = time.time()
-        self.request_count = 0
-        self.rows_served = 0
-        self.batch_count = 0
-        self.batched_rows = 0     # rows that went through the batcher
-        self.batched_requests = 0
-        self.error_count = 0
 
     # ------------------------------------------------------------- writers
     def record_request(self, rows, latency_s):
         """One client request completed (rows served, end-to-end
-        seconds)."""
-        with self._lock:
-            self.request_count += 1
-            self.rows_served += int(rows)
-            self._ring[self._ring_n % len(self._ring)] = latency_s * 1e3
-            self._ring_n += 1
+        seconds). The group updates under ONE lock hold (reentrant
+        registry lock) so a concurrent /metricz scrape never sees the
+        count without its latency sample."""
+        with self.registry.lock:
+            self._requests.inc()
+            self._rows.inc(int(rows))
+            self._latency.observe(latency_s * 1e3)
 
     def record_batch(self, rows, n_requests):
         """One coalesced device dispatch (batcher drain)."""
-        with self._lock:
-            self.batch_count += 1
-            self.batched_rows += int(rows)
-            self.batched_requests += int(n_requests)
+        with self.registry.lock:
+            self._batches.inc()
+            self._batched_rows.inc(int(rows))
+            self._batched_requests.inc(int(n_requests))
 
     def record_error(self):
-        with self._lock:
-            self.error_count += 1
+        self._errors.inc()
 
     # ------------------------------------------------------------- readers
+    @property
+    def request_count(self):
+        return self._requests.value
+
+    @property
+    def rows_served(self):
+        return self._rows.value
+
+    @property
+    def batch_count(self):
+        return self._batches.value
+
+    @property
+    def batched_rows(self):
+        return self._batched_rows.value
+
+    @property
+    def batched_requests(self):
+        return self._batched_requests.value
+
+    @property
+    def error_count(self):
+        return self._errors.value
+
     def latency_percentiles(self, pcts=(50, 95, 99)):
         """{p: milliseconds} over the ring's recorded window; empty dict
-        before the first request."""
-        with self._lock:
-            n = min(self._ring_n, len(self._ring))
-            if n == 0:
-                return {}
-            window = np.sort(self._ring[:n])
-        # nearest-rank: ceil(n*p/100) - 1 (int(n*p/100) would bias one
-        # rank high — p50 of 2 samples must be the lower one, and p99
-        # of 100 samples rank 98, not the absolute max)
-        return {p: float(window[max(0, -(-n * p // 100) - 1)])
-                for p in pcts}
+        before the first request (nearest-rank — see
+        telemetry/registry.py Histogram.percentiles)."""
+        return self._latency.percentiles(pcts)
 
     def snapshot(self):
-        """One JSON-ready dict for `/metricz`."""
-        pct = self.latency_percentiles()
-        with self._lock:
-            occ = (self.batched_rows / self.batch_count
-                   if self.batch_count else 0.0)
-            per_batch = (self.batched_requests / self.batch_count
-                         if self.batch_count else 0.0)
-            return {
+        """One JSON-ready dict for `/metricz` (field set unchanged by
+        the registry refactor; tests/test_telemetry.py pins parity).
+        Reads under one lock hold — a consistent point-in-time view."""
+        with self.registry.lock:
+            pct = self.latency_percentiles()
+            batches = self.batch_count
+            occ = self.batched_rows / batches if batches else 0.0
+            per_batch = self.batched_requests / batches if batches else 0.0
+            snap = {
                 "uptime_s": round(time.time() - self.started_at, 3),
                 "request_count": self.request_count,
                 "rows_served": self.rows_served,
                 "error_count": self.error_count,
-                "batch_count": self.batch_count,
+                "batch_count": batches,
                 "batch_occupancy_rows": round(occ, 3),
                 "batch_occupancy_requests": round(per_batch, 3),
                 "latency_p50_ms": round(pct.get(50, 0.0), 4),
                 "latency_p95_ms": round(pct.get(95, 0.0), 4),
                 "latency_p99_ms": round(pct.get(99, 0.0), 4),
-                "latency_window": min(self._ring_n, len(self._ring)),
+                "latency_window": self._latency.window,
             }
+        return snap

@@ -148,6 +148,14 @@ class Supervisor:
                            or params.get("output_model",
                                          "LightGBM_model.txt")
                            + ".snapshots")
+        # restart/shrink events land in the SAME rank journal file the
+        # training child writes (telemetry/journal.py: O_APPEND single-
+        # line writes interleave safely across processes), so the
+        # merged timeline shows abort -> restart -> resume in order
+        self.telemetry = str(params.get("telemetry", "false")).lower() \
+            in ("true", "+", "1")
+        self.telemetry_dir = params.get("telemetry_dir") or self.shared_dir
+        self._journal = None
         mlist = params.get("machine_list_file", "")
         self.machines = parse_machine_list(mlist) if mlist and \
             os.path.exists(mlist) else []
@@ -175,6 +183,18 @@ class Supervisor:
             Log.warning("supervisor: snapshot_freq is 0 — a restart "
                         "will COLD-START training (set snapshot_freq>0 "
                         "to resume from shared snapshots)")
+
+    def _journal_event(self, event, **fields):
+        """Append one supervisor-sourced record to this rank's run
+        journal (no-op unless `telemetry=true`)."""
+        if not self.telemetry:
+            return
+        if self._journal is None:
+            from .telemetry.journal import RunJournal
+            self._journal = RunJournal(self.telemetry_dir, rank=self.rank,
+                                       emit_run_start=False,
+                                       source="supervisor")
+        self._journal.event(event, **fields)
 
     def _clean_own_markers(self):
         import glob
@@ -251,6 +271,11 @@ class Supervisor:
                     [self.machines[r] for r in survivors], attempt)
                 new_rank = survivors.index(self.rank)
                 mlist_override = self._write_shrunk_mlist(machines, attempt)
+            self._journal_event("restart", attempt=attempt,
+                                exit_code=int(code),
+                                reason=describe_exit(code),
+                                survivors=list(self.members),
+                                new_rank=int(new_rank))
             Log.info("supervisor: restarting rank %d as rank %d of %d "
                      "(resume from newest snapshot under %s)", self.rank,
                      new_rank, max(len(machines), 1), self.shared_dir)

@@ -1,0 +1,27 @@
+"""Unified training telemetry: span tracing, metrics, run journal,
+live /trainz endpoint.
+
+The training-side observability stack (docs/Observability.md):
+
+- `trace.SpanTracer` — per-Booster nested span timing (replaces the
+  global `utils/timers.py` singleton), with optional
+  `jax.profiler.TraceAnnotation` passthrough.
+- `registry.MetricsRegistry` — thread-safe counters/gauges/histograms;
+  the serving layer's `/metricz` accounting (serving/metrics.py) is
+  built on the same primitives.
+- `journal.RunJournal` — append-only JSONL run timeline (atomic line
+  writes, rank-suffixed files, rank-0 merge); schema in
+  `journal.SCHEMA`, linted by `tools/check_journal.py`.
+- `trainz.start_trainz` — opt-in stdlib HTTP thread serving the live
+  training state (`telemetry_port` knob).
+
+Everything here is jax-free unless the jax-annotation passthrough is
+explicitly enabled, so the supervisor and CPU test harness can import
+it without touching the accelerator runtime.
+"""
+
+from . import journal, registry, trace, trainz  # noqa: F401
+from .journal import RunJournal, merge_journals, read_journal  # noqa: F401
+from .registry import MetricsRegistry  # noqa: F401
+from .trace import SpanTracer  # noqa: F401
+from .trainz import start_trainz, stop_trainz  # noqa: F401

@@ -1,0 +1,317 @@
+"""Structured run journal: append-only JSONL training timeline.
+
+No reference equivalent — the reference's training record is log text.
+The journal gives every run a machine-readable timeline: one record per
+completed boosting iteration (or per fused device block — the block is
+ONE XLA program, so per-iteration host phases do not exist inside it)
+plus run-start / config / checkpoint / resume / abort / restart /
+run-end events, all in the same file, so a supervisor restart or a
+watchdog abort (exit 117/118, parallel/heartbeat.py) lands in the same
+timeline as training progress.
+
+Write discipline (the whole point):
+
+- one file per rank (`journal.rank0000.jsonl`) in a shared directory —
+  multi-host ranks never contend on a writer;
+- every record is ONE `os.write` of a complete line to an O_APPEND fd:
+  appends from concurrent processes (the training child and its
+  supervisor share rank files) interleave at line granularity, and a
+  `os._exit`-style kill (utils/faults.py hard_crash) can lose at most
+  the record being written, never tear an earlier one;
+- readers (`read_journal`) skip unparseable lines, so a resumed run
+  appends past a torn tail and the timeline stays loadable;
+- rank 0 merges all rank files into `journal.jsonl` sorted by wall
+  time (`merge_journals`), called at end of training.
+
+The schema (`SCHEMA` below) is the contract `tools/check_journal.py`
+lints against and docs/Observability.md documents. This module is
+jax-free so the supervisor and CPU test harness import it without
+touching the accelerator runtime.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+
+from ..utils.log import Log
+
+SCHEMA_VERSION = 1
+MERGED_NAME = "journal.jsonl"
+
+# --------------------------------------------------------------- schema
+#
+# Per-event REQUIRED fields (name -> type). Every record also carries
+# the COMMON fields. OPTIONAL fields are type-checked when present;
+# unknown extra fields are allowed (forward compatibility), unknown
+# event names are not.
+
+COMMON_FIELDS = {"ts": float, "event": str, "rank": int}
+
+SCHEMA = {
+    "run_start": {"required": {"schema": int, "pid": int},
+                  "optional": {"run_id": str, "argv": list,
+                               "num_ranks": int, "source": str}},
+    "config": {"required": {"params": dict}, "optional": {}},
+    "iteration": {"required": {"iteration": int},
+                  "optional": {"phases": dict, "block": int,
+                               "grad_norm": float, "hess_norm": float,
+                               "leaf_count": int,
+                               "compile_cache_hit": bool,
+                               "fused": bool}},
+    "metrics": {"required": {"iteration": int, "values": dict},
+                "optional": {}},
+    "checkpoint": {"required": {"iteration": int, "path": str},
+                   "optional": {"write_s": float}},
+    "resume": {"required": {"iteration": int},
+               "optional": {"path": str, "source": str}},
+    "truncate": {"required": {"iteration": int, "dropped_iters": int},
+                 "optional": {"reason": str}},
+    "abort": {"required": {"exit_code": int, "reason": str},
+              "optional": {"collective": str, "iteration": int,
+                           "dead_ranks": list, "source": str}},
+    "restart": {"required": {"attempt": int, "exit_code": int},
+                "optional": {"reason": str, "survivors": list,
+                             "new_rank": int, "source": str}},
+    "run_end": {"required": {"iterations": int},
+                "optional": {"train_s": float, "source": str}},
+    "note": {"required": {}, "optional": {"msg": str, "source": str}},
+}
+
+# json types are exact; bool is an int subclass in Python, so int
+# checks must reject bools while float checks accept ints
+_NUMERIC = (int, float)
+
+
+def _type_ok(value, expected):
+    if expected is float:
+        return isinstance(value, _NUMERIC) and not isinstance(value, bool)
+    if expected is int:
+        return isinstance(value, int) and not isinstance(value, bool)
+    return isinstance(value, expected)
+
+
+def validate_record(rec):
+    """Validate one parsed record against SCHEMA. Returns a list of
+    error strings (empty = valid)."""
+    errors = []
+    if not isinstance(rec, dict):
+        return [f"record is not an object: {type(rec).__name__}"]
+    for name, typ in COMMON_FIELDS.items():
+        if name not in rec:
+            errors.append(f"missing common field {name!r}")
+        elif not _type_ok(rec[name], typ):
+            errors.append(f"field {name!r} has type "
+                          f"{type(rec[name]).__name__}, want {typ.__name__}")
+    event = rec.get("event")
+    if not isinstance(event, str):
+        return errors
+    spec = SCHEMA.get(event)
+    if spec is None:
+        errors.append(f"unknown event {event!r}")
+        return errors
+    for name, typ in spec["required"].items():
+        if name not in rec:
+            errors.append(f"{event}: missing required field {name!r}")
+        elif not _type_ok(rec[name], typ):
+            errors.append(f"{event}: field {name!r} has type "
+                          f"{type(rec[name]).__name__}, want {typ.__name__}")
+    for name, typ in spec["optional"].items():
+        # None is legal anywhere optional: the writer null-sanitizes
+        # non-finite floats (JSON has no NaN/Inf literal)
+        if name in rec and rec[name] is not None \
+                and not _type_ok(rec[name], typ):
+            errors.append(f"{event}: optional field {name!r} has type "
+                          f"{type(rec[name]).__name__}, want {typ.__name__}")
+    if event == "iteration":
+        for k, v in (rec.get("phases") or {}).items():
+            if v is not None and not _type_ok(v, float):
+                errors.append(f"iteration: phases[{k!r}] is not a number")
+    return errors
+
+
+# -------------------------------------------------------------- writing
+
+def _sanitize(value):
+    """Deep-replace non-finite floats with None so the record stays
+    strict JSON."""
+    if isinstance(value, float):
+        return value if value == value and abs(value) != float("inf") \
+            else None
+    if isinstance(value, dict):
+        return {k: _sanitize(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_sanitize(v) for v in value]
+    return value
+
+
+def journal_path(directory, rank):
+    return os.path.join(os.fspath(directory),
+                        f"journal.rank{int(rank):04d}.jsonl")
+
+
+class RunJournal:
+    """One rank's append-only journal (see module docstring).
+
+    `emit_run_start=False` attaches to an EXISTING rank file without
+    opening a new run (the supervisor appending restart events, a
+    resumed child continuing the timeline). `source` tags every record
+    from this writer (e.g. "supervisor")."""
+
+    def __init__(self, directory, rank=0, emit_run_start=True, meta=None,
+                 source=None):
+        self.directory = os.fspath(directory)
+        self.rank = int(rank)
+        self.source = source
+        self.path = journal_path(self.directory, self.rank)
+        self._lock = threading.Lock()
+        self._fd = None
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            self._fd = os.open(self.path,
+                               os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                               0o644)
+        except OSError as e:
+            Log.warning("run journal disabled (cannot open %s: %s)",
+                        self.path, e)
+        if emit_run_start:
+            self.event("run_start", schema=SCHEMA_VERSION, pid=os.getpid(),
+                       **(meta or {}))
+
+    @property
+    def enabled(self):
+        return self._fd is not None
+
+    def event(self, event, **fields):
+        """Append one record: a single O_APPEND write of a complete
+        line. Never raises — a full disk must not kill training."""
+        if self._fd is None:
+            return
+        rec = {"ts": time.time(), "event": event, "rank": self.rank}
+        if self.source is not None:
+            rec["source"] = self.source
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, separators=(",", ":"),
+                              allow_nan=False) + "\n"
+        except (TypeError, ValueError):
+            # NaN/Inf (JSON has no literal for them) or a non-JSON
+            # value: sanitize rather than drop the record — readers
+            # need every line to parse
+            line = json.dumps(_sanitize(rec), separators=(",", ":"),
+                              allow_nan=False, default=str) + "\n"
+        try:
+            os.write(self._fd, line.encode("utf-8"))
+        except OSError as e:
+            Log.warning("journal write failed (%s): %s", self.path, e)
+
+    def iteration(self, iteration, phases=None, **fields):
+        if phases:
+            fields["phases"] = phases
+        self.event("iteration", iteration=int(iteration), **fields)
+
+    def close(self):
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+                self._fd = None
+
+    def __del__(self):
+        # Python-API runs may drop a booster without an explicit
+        # close_telemetry(); the raw fd must not outlive the journal
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# -------------------------------------------------------------- reading
+
+def read_journal(path, strict=False):
+    """Parse one JSONL journal file. Torn/garbled lines are skipped
+    (and counted) unless `strict`; returns (records, n_bad)."""
+    records, bad = [], 0
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    bad += 1
+                    if strict:
+                        raise
+    except OSError:
+        return [], 0
+    return records, bad
+
+
+def rank_files(directory):
+    return sorted(glob.glob(os.path.join(os.fspath(directory),
+                                         "journal.rank*.jsonl")))
+
+
+def tail(path, n=20):
+    """Last `n` parsed records of a journal file (newest last)."""
+    records, _ = read_journal(path)
+    return records[-int(n):]
+
+
+def merge_journals(directory, out_path=None):
+    """Merge every rank's journal into one wall-time-sorted timeline
+    (rank 0 calls this at end of training; `tools/check_journal.py`
+    lints the result). The sort is stable, so same-timestamp records
+    keep rank-file order. Returns the merged path or None when there
+    was nothing to merge."""
+    files = rank_files(directory)
+    if not files:
+        return None
+    merged = []
+    for path in files:
+        records, bad = read_journal(path)
+        if bad:
+            Log.warning("journal merge: skipped %d torn line(s) in %s",
+                        bad, path)
+        merged.extend(records)
+    merged.sort(key=lambda r: r.get("ts", 0.0))
+    out_path = out_path or os.path.join(os.fspath(directory), MERGED_NAME)
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            for rec in merged:
+                f.write(json.dumps(rec, separators=(",", ":"),
+                                   default=str) + "\n")
+        os.replace(tmp, out_path)
+    except OSError as e:
+        Log.warning("journal merge failed (%s): %s", out_path, e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+    return out_path
+
+
+# --------------------------------------------------- process-wide handle
+#
+# Cross-cutting emitters (the collective watchdog's abort path, the
+# heartbeat monitor's peer-loss path) need the active journal without a
+# booster reference — one training run per process, same singleton
+# shape as parallel/heartbeat.py.
+
+_CURRENT = None
+
+
+def set_current(journal):
+    global _CURRENT
+    _CURRENT = journal
+
+
+def current():
+    return _CURRENT

@@ -1,0 +1,195 @@
+"""Span tracer: nested, tagged wall-clock spans for the training loop.
+
+Replaces the `utils/timers.py` global `PhaseTimers` singleton (whose
+accumulator two Boosters trained in one process silently shared) with a
+per-Booster instance. The reference's observability surface is the
+cumulative network-time counters in include/LightGBM/network.h /
+src/network/linkers.h:195-212 plus ad-hoc timers in application.cpp;
+GPU tree-boosting systems report per-kernel phase breakdowns as the
+primary tuning instrument (arXiv:1706.08359, arXiv:2005.09148) — the
+tracer is that instrument for the host-visible side of training.
+
+Three views of the same spans:
+
+- **Accumulator** (`acc`/`cnt`/`snapshot`/`report`): per-phase total
+  seconds and call counts, drop-in compatible with the old PhaseTimers
+  API so existing call sites and the bench keep working.
+- **Deltas** (`delta_snapshot`): per-phase seconds since the previous
+  call — what the run journal attaches to each iteration record.
+- **Recent spans** (`recent`): a bounded ring of completed spans with
+  nesting path, start offset and tags — the `/trainz` endpoint's live
+  breakdown.
+
+Spans nest via a thread-local stack ("train/build" style paths), are
+exception-safe (the `finally` always closes the span), and optionally
+pass through to `jax.profiler.TraceAnnotation` so host spans line up
+with XLA device traces (`telemetry_jax_annotations` knob; the import
+is lazy so this module stays jax-free unless the passthrough is on).
+"""
+
+import threading
+import time
+from collections import defaultdict, deque
+
+RECENT_SPANS = 256
+
+
+class Span:
+    """One completed (or open) span. `path` includes parents:
+    "train/build"."""
+
+    __slots__ = ("name", "path", "start", "duration", "tags")
+
+    def __init__(self, name, path, start, duration=None, tags=None):
+        self.name = name
+        self.path = path
+        self.start = start
+        self.duration = duration
+        self.tags = tags or {}
+
+    def as_dict(self):
+        return {"name": self.name, "path": self.path,
+                "start_s": round(self.start, 6),
+                "duration_s": (round(self.duration, 6)
+                               if self.duration is not None else None),
+                **({"tags": self.tags} if self.tags else {})}
+
+
+class _SpanContext:
+    """Context manager for one span; created by SpanTracer.span()."""
+
+    __slots__ = ("_tracer", "_name", "_tags", "_t0", "_path", "_ann")
+
+    def __init__(self, tracer, name, tags):
+        self._tracer = tracer
+        self._name = name
+        self._tags = tags
+        self._t0 = None
+        self._path = None
+        self._ann = None
+
+    def __enter__(self):
+        tr = self._tracer
+        stack = tr._stack()
+        self._path = ("/".join(s for s in stack) + "/" + self._name
+                      if stack else self._name)
+        stack.append(self._name)
+        if tr.jax_annotations:
+            self._ann = tr._annotation(self._name)
+            if self._ann is not None:
+                self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        elapsed = time.perf_counter() - self._t0
+        tr = self._tracer
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        stack = tr._stack()
+        if stack and stack[-1] == self._name:
+            stack.pop()
+        tr._record(self._name, self._path, elapsed, self._t0, self._tags)
+        return False
+
+
+class SpanTracer:
+    """Per-Booster span registry (see module docstring).
+
+    The accumulator keys on the LEAF name (not the path) so nested and
+    flat call sites aggregate the same way the old PhaseTimers did.
+    Thread-safe: concurrent threads keep independent nesting stacks and
+    the shared accumulator mutates under one lock.
+    """
+
+    def __init__(self, rank=0, jax_annotations=False):
+        self.rank = int(rank)
+        self.jax_annotations = bool(jax_annotations)
+        self.acc = defaultdict(float)
+        self.cnt = defaultdict(int)
+        self._lock = threading.Lock()
+        self._last = {}            # delta_snapshot baseline
+        self._recent = deque(maxlen=RECENT_SPANS)
+        self._local = threading.local()
+        self._epoch = time.perf_counter()
+
+    # ------------------------------------------------------------- spans
+    def _stack(self):
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    @staticmethod
+    def _annotation(name):
+        try:
+            import jax
+            return jax.profiler.TraceAnnotation(name)
+        except Exception:   # jax absent / profiler API drift: span still times
+            return None
+
+    def span(self, name, **tags):
+        """Context manager timing one (possibly nested) span."""
+        return _SpanContext(self, name, tags)
+
+    # PhaseTimers-compatible alias: `with tracer.phase("build"): ...`
+    phase = span
+
+    def _record(self, name, path, elapsed, t0, tags):
+        with self._lock:
+            self.acc[name] += elapsed
+            self.cnt[name] += 1
+            self._recent.append(Span(name, path, t0 - self._epoch,
+                                     elapsed, tags))
+
+    def add(self, name, seconds):
+        """Accumulate an externally-timed phase (e.g. the bench's
+        compile window)."""
+        with self._lock:
+            self.acc[name] += float(seconds)
+            self.cnt[name] += 1
+
+    # ----------------------------------------------------------- readers
+    def reset(self):
+        with self._lock:
+            self.acc.clear()
+            self.cnt.clear()
+            self._last.clear()
+            self._recent.clear()
+            self._epoch = time.perf_counter()
+
+    def snapshot(self):
+        """{phase: total_seconds}, machine-readable (bench JSON)."""
+        with self._lock:
+            return {k: round(v, 6) for k, v in self.acc.items()}
+
+    def delta_snapshot(self):
+        """{phase: seconds since the previous delta_snapshot call} —
+        only phases that moved. The run journal attaches this to each
+        iteration record so per-record phase seconds sum back to the
+        run totals."""
+        out = {}
+        with self._lock:
+            for name, total in self.acc.items():
+                d = total - self._last.get(name, 0.0)
+                if d > 0:
+                    out[name] = round(d, 6)
+                self._last[name] = total
+        return out
+
+    def recent(self, n=32):
+        """Last `n` completed spans, oldest first (`/trainz`)."""
+        with self._lock:
+            spans = list(self._recent)[-int(n):]
+        return [s.as_dict() for s in spans]
+
+    def report(self):
+        """One line per phase, largest first (the old PhaseTimers
+        debug report)."""
+        with self._lock:
+            items = sorted(self.acc.items(), key=lambda kv: -kv[1])
+            lines = ["%-12s %8.3fs total, %7.2fms/call x%d"
+                     % (name, total, 1e3 * total / max(self.cnt[name], 1),
+                        self.cnt[name])
+                     for name, total in items]
+        return "\n".join(lines)

@@ -1,0 +1,124 @@
+"""Live training introspection endpoint: GET /trainz.
+
+A tiny opt-in stdlib HTTP thread (the serving layer's stdlib-only
+pattern, serving/server.py — the telemetry surface must not add
+dependencies the training image lacks) exposing the CURRENT state of a
+training run as one JSON document:
+
+- `iteration`: the booster's completed-iteration count
+- `phases`: the span tracer's per-phase accumulated seconds
+- `spans`: the most recent completed spans (path/start/duration)
+- `metrics`: the metrics registry snapshot (counters/gauges/histograms)
+- `heartbeats`: per-rank seconds since each peer's beat last changed
+  (multi-host runs with the heartbeat service up; parallel/heartbeat.py)
+- `journal_tail`: the last records of this rank's run journal
+
+Also serves /healthz (liveness). Enabled by `telemetry_port > 0`
+(docs/Parameters.md); `start_trainz(..., port=0)` binds an ephemeral
+port (tests). The handler thread only READS shared state — it can
+never stall the training loop.
+
+Sources are held weakly-ish via zero-arg callables so a finished
+booster is not kept alive by a lingering server thread.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.log import Log
+from . import journal as journal_mod
+
+
+class TrainzHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    sources = None   # bound by start_trainz
+
+    def log_message(self, fmt, *args):   # route access logs through ours
+        Log.debug("trainz: " + fmt, *args)
+
+    def _reply(self, code, obj):
+        data = json.dumps(obj, default=str).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        if path.startswith("/healthz"):
+            self._reply(200, {"status": "ok"})
+            return
+        if not path.startswith("/trainz"):
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        out = {}
+        for name, fn in (self.sources or {}).items():
+            try:
+                out[name] = fn()
+            except Exception as e:   # a dead source must not 500 the page
+                out[name] = {"error": str(e)}
+        self._reply(200, out)
+
+
+def build_sources(iteration_fn=None, tracer=None, registry=None,
+                  journal=None, tail_n=20):
+    """Assemble the /trainz source map from whatever exists. The
+    heartbeat service is resolved lazily per request (it may start
+    after the endpoint does)."""
+    sources = {}
+    if iteration_fn is not None:
+        sources["iteration"] = lambda: int(iteration_fn())
+    if tracer is not None:
+        sources["phases"] = tracer.snapshot
+        sources["spans"] = tracer.recent
+    if registry is not None:
+        sources["metrics"] = registry.snapshot
+
+    def heartbeats():
+        from ..parallel import heartbeat
+        svc = heartbeat.service()
+        if svc is None:
+            return None
+        return {"rank": svc.rank,
+                "peer_age_s": {str(r): round(a, 3)
+                               for r, a in svc.peer_ages().items()},
+                "dead_peers": svc.dead_peers()}
+
+    sources["heartbeats"] = heartbeats
+    if journal is not None:
+        sources["journal_tail"] = lambda: journal_mod.tail(journal.path,
+                                                           tail_n)
+    return sources
+
+
+def start_trainz(sources, port, host="127.0.0.1"):
+    """Start the daemon /trainz server; returns it (server_address[1]
+    carries the bound port — pass port=0 for ephemeral). Returns None
+    when the bind fails: telemetry must never kill training."""
+    handler = type("BoundTrainzHandler", (TrainzHandler,),
+                   {"sources": dict(sources)})
+    try:
+        srv = ThreadingHTTPServer((host, int(port)), handler)
+    except OSError as e:
+        Log.warning("/trainz disabled (cannot bind %s:%s: %s)",
+                    host, port, e)
+        return None
+    srv.daemon_threads = True
+    thread = threading.Thread(target=srv.serve_forever, daemon=True,
+                              name="lgbm-tpu-trainz")
+    thread.start()
+    Log.info("/trainz live on http://%s:%d/trainz", host,
+             srv.server_address[1])
+    return srv
+
+
+def stop_trainz(srv):
+    if srv is None:
+        return
+    try:
+        srv.shutdown()
+        srv.server_close()
+    except Exception:
+        pass

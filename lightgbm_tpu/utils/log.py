@@ -2,8 +2,27 @@
 
 Reference: include/LightGBM/utils/log.h:14-98. Fatal raises (the reference
 throws std::runtime_error caught at the CLI / C-API boundary).
+
+Observability extensions (no reference equivalent; the defaults keep
+the reference's exact line shape):
+
+- `LIGHTGBM_TPU_LOG_TS=1` (or `Log.enable_timestamps()`): ISO-8601
+  timestamps on every line.
+- `LIGHTGBM_TPU_LOG_JSON=1`: structured-line mode — each line is one
+  JSON object `{"ts","level","msg","rank"}` so supervisor child logs
+  are machine-parseable next to the run journal
+  (docs/Observability.md).
+- rank prefix: injected once `Log.set_rank()` is called (done by
+  parallel/distributed.py when jax.distributed comes up), so
+  interleaved multi-rank output stays attributable.
+
+The env flags are re-read per line (they are off the hot path; a
+supervisor can flip a child's format purely through its environment).
 """
 
+import datetime
+import json
+import os
 import sys
 
 
@@ -14,6 +33,8 @@ class LightGBMError(Exception):
 class Log:
     # levels: fatal=-1, warning=0, info=1, debug=2
     _level = 1
+    _rank = None        # set by set_rank(); None = no rank prefix
+    _timestamps = False  # ISO-8601 prefix (or LIGHTGBM_TPU_LOG_TS=1)
 
     @classmethod
     def reset_log_level(cls, level: int) -> None:
@@ -30,6 +51,17 @@ class Log:
             cls._level = 2
         else:
             cls._level = -1
+
+    @classmethod
+    def set_rank(cls, rank) -> None:
+        """Prefix subsequent lines with `[rank N]` (and a "rank" field
+        in JSON mode). Called when jax.distributed initializes
+        (parallel/distributed.py); None clears."""
+        cls._rank = int(rank) if rank is not None else None
+
+    @classmethod
+    def enable_timestamps(cls, on=True) -> None:
+        cls._timestamps = bool(on)
 
     @classmethod
     def debug(cls, fmt, *args):
@@ -51,10 +83,27 @@ class Log:
         msg = (fmt % args) if args else str(fmt)
         raise LightGBMError(msg)
 
-    @staticmethod
-    def _write(level_str, fmt, args):
+    @classmethod
+    def _write(cls, level_str, fmt, args):
         msg = (fmt % args) if args else str(fmt)
-        sys.stdout.write(f"[LightGBM-TPU] [{level_str}] {msg}\n")
+        if os.environ.get("LIGHTGBM_TPU_LOG_JSON", "") not in ("", "0"):
+            rec = {"ts": datetime.datetime.now().isoformat(
+                       timespec="milliseconds"),
+                   "level": level_str, "msg": msg}
+            if cls._rank is not None:
+                rec["rank"] = cls._rank
+            sys.stdout.write(json.dumps(rec, default=str) + "\n")
+            sys.stdout.flush()
+            return
+        parts = ["[LightGBM-TPU]"]
+        if cls._timestamps or os.environ.get("LIGHTGBM_TPU_LOG_TS",
+                                             "") not in ("", "0"):
+            parts.append("[" + datetime.datetime.now().isoformat(
+                timespec="milliseconds") + "]")
+        if cls._rank is not None:
+            parts.append(f"[rank {cls._rank}]")
+        parts.append(f"[{level_str}] {msg}")
+        sys.stdout.write(" ".join(parts) + "\n")
         sys.stdout.flush()
 
 

@@ -1,62 +1,20 @@
-"""Per-phase wall-clock timers.
+"""DEPRECATED shim: per-phase timers moved to the telemetry package.
 
-Reference observability surface: the cumulative network-time counters in
-include/LightGBM/network.h / src/network/linkers.h:195-212 and the
-per-iteration / load timers sprinkled through application.cpp. On TPU
-the phases that matter are different — gradient computation, tree build
-(device program + the scalar stop-check sync), score updates, host<->
-device sync, and metric evaluation — so the registry tracks those. XLA
-owns collective scheduling inside the compiled program; fine-grained
-collective time comes from `jax.profiler` traces (CLI flag `profile=1`),
-not host timers.
+`PhaseTimers` is now `lightgbm_tpu.telemetry.trace.SpanTracer` (a
+superset: nesting, tags, delta snapshots, jax.profiler annotation
+passthrough) and the training loop keeps a PER-BOOSTER tracer
+(`GBDT.tracer`) instead of this module's process-global singleton —
+two Boosters trained in one process used to accumulate into the same
+`TIMERS.acc`, cross-contaminating every phase total.
 
-Usage:
-    with TIMERS.phase("build"):
-        ...
-    Log.debug-level report via TIMERS.report() at end of training.
+The module-level `TIMERS` instance remains for external callers that
+imported it (same `.phase()/.add()/.reset()/.snapshot()/.report()`
+API), but nothing inside the package writes to it anymore. Migrate to
+`booster.gbdt.tracer` (Python API) / `self.boosting.tracer` (CLI
+embedders) — see docs/Observability.md.
 """
 
-import time
-from collections import defaultdict
-from contextlib import contextmanager
+from ..telemetry.trace import SpanTracer as PhaseTimers  # noqa: F401
 
-
-class PhaseTimers:
-    def __init__(self):
-        self.acc = defaultdict(float)
-        self.cnt = defaultdict(int)
-
-    @contextmanager
-    def phase(self, name):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.acc[name] += time.perf_counter() - t0
-            self.cnt[name] += 1
-
-    def add(self, name, seconds):
-        self.acc[name] += seconds
-        self.cnt[name] += 1
-
-    def reset(self):
-        self.acc.clear()
-        self.cnt.clear()
-
-    def snapshot(self):
-        """{phase: total_seconds} for machine-readable reporting (the
-        bench emits this in its result JSON)."""
-        return {k: round(v, 3) for k, v in self.acc.items()}
-
-    def report(self):
-        """One line per phase, largest first."""
-        lines = []
-        for name in sorted(self.acc, key=lambda k: -self.acc[k]):
-            n = max(self.cnt[name], 1)
-            lines.append("%-12s %8.3fs total, %7.2fms/call x%d"
-                         % (name, self.acc[name], 1e3 * self.acc[name] / n,
-                            self.cnt[name]))
-        return "\n".join(lines)
-
-
+# Deprecated process-global instance (see module docstring).
 TIMERS = PhaseTimers()

@@ -280,7 +280,7 @@ def test_rank_crash_supervisor_restart_model_parity(tmp_path):
     2-rank topology."""
     _write_data(tmp_path / "tr.csv")
     knobs = ("heartbeat_timeout_s=6", "collective_timeout_s=30",
-             "max_restarts=2")
+             "max_restarts=2", "telemetry=true")
     ref = _gang(tmp_path, "ref", "lightgbm_tpu", [None, None], knobs)
     for rank, (rc, out) in enumerate(ref):
         assert rc == 0, f"ref rank {rank} failed:\n{out[-3000:]}"
@@ -302,6 +302,23 @@ def test_rank_crash_supervisor_restart_model_parity(tmp_path):
     ref_model = (tmp_path / "ref" / "model.txt").read_text()
     crash_model = (tmp_path / "crash" / "model.txt").read_text()
     assert crash_model == ref_model  # byte-identical
+    # the whole failure story is machine-readable in the merged run
+    # journal: abort (the survivor's detection) -> supervisor restart
+    # -> resume from the shared snapshot (telemetry/journal.py)
+    from lightgbm_tpu.telemetry.journal import read_journal, validate_record
+    merged = tmp_path / "crash" / "snaps" / "journal.jsonl"
+    records, bad = read_journal(str(merged))
+    assert bad == 0 and records
+    for rec in records:
+        assert validate_record(rec) == [], rec
+    events = [rec["event"] for rec in records]
+    assert any(rec["event"] == "abort"
+               and rec["exit_code"] in (hb.EXIT_WATCHDOG,
+                                        hb.EXIT_PEER_LOST)
+               for rec in records)
+    assert any(rec["event"] == "restart"
+               and rec.get("source") == "supervisor" for rec in records)
+    assert "resume" in events and "run_end" in events
 
 
 def test_watchdog_abort_names_hung_rank_iteration_collective(tmp_path):
@@ -312,7 +329,8 @@ def test_watchdog_abort_names_hung_rank_iteration_collective(tmp_path):
     _write_data(tmp_path / "tr.csv")
     results = _gang(tmp_path, "hang", "lightgbm_tpu",
                     ["rank_hang_at_iteration=1:3"] * 2,
-                    ("heartbeat_timeout_s=30", "collective_timeout_s=6"),
+                    ("heartbeat_timeout_s=30", "collective_timeout_s=6",
+                     "telemetry=true"),
                     timeout=120)
     rc0, out0 = results[0]
     assert rc0 == hb.EXIT_WATCHDOG, out0[-3000:]
@@ -331,6 +349,15 @@ def test_watchdog_abort_names_hung_rank_iteration_collective(tmp_path):
     # the hung rank terminated too (its own monitor saw rank 0 die, or
     # the distributed runtime aborted it) — nothing left to leak
     assert results[1][0] != 0
+    # the abort is in the journal with the same diagnosis the marker
+    # carries — written just before os._exit(117)
+    from lightgbm_tpu.telemetry.journal import journal_path, read_journal
+    records, bad = read_journal(
+        journal_path(tmp_path / "hang" / "snaps", 0))
+    assert bad == 0
+    abort = next(rec for rec in records if rec["event"] == "abort")
+    assert abort["exit_code"] == hb.EXIT_WATCHDOG
+    assert abort["iteration"] == 3 and abort["collective"]
 
 
 def test_shrunken_world_restart_smoke(tmp_path):

@@ -1,0 +1,474 @@
+"""Unified training telemetry (lightgbm_tpu/telemetry/): span tracer,
+metrics registry, structured run journal, /trainz endpoint, and the
+serving /metricz parity after its refactor onto the registry.
+
+Covers the contracts docs/Observability.md documents: span nesting and
+exception safety, per-Booster tracer isolation (the old TIMERS
+singleton cross-contamination), registry thread-safety under
+concurrent writers, journal line atomicity across a hard kill + resume
+(no torn JSONL), multi-rank merge ordering, schema lint of a REAL
+training journal, and phase-delta reconstruction (the bench's journal
+-> phases path).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import (MetricsRegistry, RunJournal,
+                                    SpanTracer, merge_journals,
+                                    read_journal, start_trainz,
+                                    stop_trainz, trainz)
+from lightgbm_tpu.telemetry.journal import (journal_path, rank_files,
+                                            validate_record)
+from lightgbm_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(__file__))
+
+
+def _train(tmp_path, tag, n_rounds=4, fobj=None, **extra_params):
+    rng = np.random.RandomState(3)
+    x = rng.rand(300, 5)
+    y = (x[:, 0] + x[:, 1] > 1).astype(float)
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 10, "verbose": 0,
+              "telemetry": True,
+              "telemetry_dir": str(tmp_path / tag)}
+    params.update(extra_params)
+    return lgb.train(params, lgb.Dataset(x, y), num_boost_round=n_rounds,
+                     fobj=fobj)
+
+
+def _sigmoid_fobj(preds, train_data):
+    labels = train_data.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return p - labels, p * (1 - p)
+
+
+# ------------------------------------------------------------ span tracer
+
+def test_span_nesting_and_exception_safety():
+    t = SpanTracer()
+    with pytest.raises(ValueError):
+        with t.span("outer"):
+            with t.span("inner", leaf=3):
+                raise ValueError("boom")
+    # both spans closed despite the exception, nesting path recorded
+    assert t.cnt["outer"] == 1 and t.cnt["inner"] == 1
+    assert t.acc["outer"] >= t.acc["inner"] >= 0.0
+    paths = {s["path"] for s in t.recent()}
+    assert "outer/inner" in paths and "outer" in paths
+    assert t._stack() == []  # stack unwound
+    # next span is top-level again
+    with t.span("after"):
+        pass
+    assert [s["path"] for s in t.recent()][-1] == "after"
+
+
+def test_span_delta_snapshot_sums_to_totals():
+    t = SpanTracer()
+    deltas = []
+    for _ in range(3):
+        with t.phase("build"):
+            time.sleep(0.002)
+        deltas.append(t.delta_snapshot().get("build", 0.0))
+    assert all(d > 0 for d in deltas)
+    assert sum(deltas) == pytest.approx(t.snapshot()["build"], abs=1e-5)
+    assert t.delta_snapshot() == {}  # nothing moved since
+
+
+def test_phase_timers_shim_compat():
+    # utils/timers.py deprecation shim: old API surface intact
+    from lightgbm_tpu.utils.timers import TIMERS, PhaseTimers
+    pt = PhaseTimers()
+    with pt.phase("a"):
+        pass
+    pt.add("b", 0.5)
+    assert set(pt.snapshot()) == {"a", "b"}
+    assert "b" in pt.report()
+    pt.reset()
+    assert pt.snapshot() == {}
+    assert hasattr(TIMERS, "phase")
+
+
+def test_per_booster_tracer_isolation(tmp_path):
+    """Two Boosters trained in one process keep independent phase
+    accumulators (the TIMERS global-singleton cross-contamination this
+    PR removes), and the deprecated global stays untouched."""
+    from lightgbm_tpu.utils.timers import TIMERS
+    TIMERS.reset()
+    b1 = _train(tmp_path, "iso1", n_rounds=4)
+    snap1 = dict(b1.gbdt.tracer.snapshot())
+    b2 = _train(tmp_path, "iso2", n_rounds=2)
+    assert b1.gbdt.tracer is not b2.gbdt.tracer
+    # training booster 2 did not move booster 1's accumulator
+    assert b1.gbdt.tracer.snapshot() == snap1
+    assert b2.gbdt.tracer.snapshot()
+    assert dict(TIMERS.acc) == {}
+
+
+# ------------------------------------------------------- metrics registry
+
+def test_registry_thread_safety_under_concurrent_writers():
+    reg = MetricsRegistry()
+    n_threads, n_ops = 8, 500
+    barrier = threading.Barrier(n_threads)
+
+    def writer(i):
+        barrier.wait()
+        for k in range(n_ops):
+            reg.inc("ops")
+            reg.inc("bytes", 10)
+            reg.set("last_writer", i)
+            reg.observe("lat", (i * n_ops + k) % 97)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    snap = reg.snapshot()
+    assert snap["counters"]["ops"] == n_threads * n_ops
+    assert snap["counters"]["bytes"] == 10 * n_threads * n_ops
+    assert snap["histograms"]["lat"]["count"] == n_threads * n_ops
+    assert 0 <= snap["gauges"]["last_writer"] < n_threads
+
+
+def test_registry_histogram_percentiles_nearest_rank():
+    reg = MetricsRegistry()
+    h = reg.histogram("h")
+    h.observe(1.0)
+    h.observe(100.0)
+    assert h.percentiles()[50] == pytest.approx(1.0)  # lower, not max
+    h2 = reg.histogram("h2")
+    for i in range(100):
+        h2.observe(float(i + 1))
+    pct = h2.percentiles()
+    assert pct[50] == pytest.approx(50.0)
+    assert pct[99] == pytest.approx(99.0)  # rank 98, not the max
+
+
+# ------------------------------------------------------------ run journal
+
+def test_journal_records_validate_and_phases_reconstruct(tmp_path):
+    """A real per-iteration training run: every record passes the
+    schema lint and the per-record phase deltas sum back to the
+    tracer's run totals (the bench's journal -> phases path)."""
+    bst = _train(tmp_path, "lint", n_rounds=4, fobj=_sigmoid_fobj)
+    g = bst.gbdt
+    records, bad = read_journal(g.journal.path)
+    assert bad == 0
+    for rec in records:
+        assert validate_record(rec) == [], rec
+    it_recs = [r for r in records if r["event"] == "iteration"]
+    assert [r["iteration"] for r in it_recs] == [1, 2, 3, 4]
+    for rec in it_recs:  # per-iteration health fields present
+        assert rec["grad_norm"] > 0 and rec["hess_norm"] > 0
+        assert rec["leaf_count"] > 0
+    totals = {}
+    for rec in it_recs:
+        for name, secs in rec["phases"].items():
+            totals[name] = totals.get(name, 0.0) + secs
+    run_totals = g.tracer.snapshot()
+    for name in ("build", "score_upd", "host_sync"):
+        assert totals[name] == pytest.approx(run_totals[name], abs=1e-4)
+
+
+def test_journal_fused_block_record(tmp_path):
+    bst = _train(tmp_path, "fused", n_rounds=5)
+    records, _ = read_journal(bst.gbdt.journal.path)
+    blocks = [r for r in records if r["event"] == "iteration"]
+    assert blocks and blocks[-1]["fused"] is True
+    assert sum(r["block"] for r in blocks) == 5
+    assert "compile_cache_hit" in blocks[-1]
+    assert "fused_block" in blocks[0]["phases"]
+
+
+def test_journal_atomic_lines_across_hard_kill(tmp_path):
+    """A writer os._exit-killed mid-stream (the preemption analog) must
+    leave only complete lines; a second writer (the resumed run)
+    appends past them and the file stays fully parseable."""
+    d = str(tmp_path)
+    code = (
+        "from lightgbm_tpu.telemetry.journal import RunJournal\n"
+        "import os\n"
+        f"j = RunJournal({d!r}, rank=0)\n"
+        "for i in range(200):\n"
+        "    j.iteration(i + 1, phases={'build': 0.001})\n"
+        "os._exit(43)\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu",
+                                PALLAS_AXON_POOL_IPS=""),
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 43
+    # resumed writer appends to the same rank file
+    j2 = RunJournal(d, rank=0, emit_run_start=False)
+    j2.event("resume", iteration=200)
+    j2.close()
+    records, bad = read_journal(journal_path(d, 0))
+    assert bad == 0, "torn JSONL line survived the kill"
+    assert records[0]["event"] == "run_start"
+    assert records[-1]["event"] == "resume"
+    assert sum(r["event"] == "iteration" for r in records) == 200
+    for rec in records:
+        assert validate_record(rec) == []
+
+
+def test_cli_crash_resume_lands_in_journal(tmp_path):
+    """End to end through the CLI: a hard-killed run leaves its journal
+    mid-iteration; the auto-resumed rerun appends a resume event and a
+    run_end, the merged timeline lints clean, and no line is torn."""
+    data = str(tmp_path / "train.tsv")
+    rng = np.random.RandomState(5)
+    x = rng.rand(400, 4)
+    y = (x[:, 0] + x[:, 1] > 1).astype(int)
+    with open(data, "w") as f:
+        for i in range(400):
+            f.write(str(y[i]) + "\t"
+                    + "\t".join(f"{v:.6f}" for v in x[i]) + "\n")
+    out_model = str(tmp_path / "model.txt")
+    args = ["task=train", f"data={data}", "objective=binary",
+            "num_trees=12", "num_leaves=7", "min_data_in_leaf=10",
+            "metric_freq=0", "enable_load_from_binary_file=false",
+            "snapshot_freq=4", f"output_model={out_model}",
+            "telemetry=true"]
+
+    def run(crash_env=None):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="")
+        env.pop(faults.ENV_VAR, None)
+        if crash_env:
+            env[faults.ENV_VAR] = crash_env
+        return subprocess.run([sys.executable, "-m", "lightgbm_tpu"]
+                              + args, cwd=REPO, env=env,
+                              capture_output=True, text=True, timeout=420)
+
+    r = run(crash_env="crash_at_iteration=8,hard_crash=1")
+    assert r.returncode == faults.HARD_CRASH_EXIT_CODE
+    jdir = out_model + ".snapshots"   # telemetry_dir defaults here
+    records, bad = read_journal(journal_path(jdir, 0))
+    assert bad == 0
+    assert any(rec["event"] == "iteration" for rec in records)
+
+    r = run()   # plain rerun auto-resumes
+    assert r.returncode == 0, r.stdout + r.stderr
+    merged = os.path.join(jdir, "journal.jsonl")
+    assert os.path.exists(merged)   # rank 0 merged at end of training
+    records, bad = read_journal(merged)
+    assert bad == 0
+    for rec in records:
+        assert validate_record(rec) == [], rec
+    events = [rec["event"] for rec in records]
+    assert events.count("run_start") == 2   # both incarnations
+    assert "resume" in events and "checkpoint" in events
+    assert events[-1] == "run_end"
+    resume = next(rec for rec in records if rec["event"] == "resume")
+    assert resume["iteration"] == 8   # newest snapshot cadence point
+
+
+def test_multi_rank_journal_merge(tmp_path):
+    d = str(tmp_path)
+    j0 = RunJournal(d, rank=0, meta={"num_ranks": 2})
+    j1 = RunJournal(d, rank=1, meta={"num_ranks": 2})
+    j0.iteration(1)
+    time.sleep(0.01)
+    j1.iteration(1)
+    time.sleep(0.01)
+    j1.event("abort", exit_code=117, reason="collective_watchdog",
+             collective="tree_build", iteration=2)
+    j0.event("run_end", iterations=1)
+    j0.close()
+    j1.close()
+    assert len(rank_files(d)) == 2
+    merged = merge_journals(d)
+    records, bad = read_journal(merged)
+    assert bad == 0
+    ts = [rec["ts"] for rec in records]
+    assert ts == sorted(ts)   # one wall-time-ordered timeline
+    ranks = {rec["rank"] for rec in records}
+    assert ranks == {0, 1}
+    abort = next(rec for rec in records if rec["event"] == "abort")
+    assert abort["rank"] == 1 and abort["exit_code"] == 117
+
+
+def test_watchdog_expiry_writes_journal_abort(tmp_path):
+    from lightgbm_tpu.parallel import heartbeat as hb
+    from lightgbm_tpu.telemetry import journal as run_journal
+    j = RunJournal(str(tmp_path), rank=2, emit_run_start=False)
+    run_journal.set_current(j)
+    try:
+        wd = hb.CollectiveWatchdog(0.1, rank=2,
+                                   on_expire=lambda n, i: None)
+        wd.set_iteration(7)
+        with wd.armed("hist_psum"):
+            time.sleep(0.3)
+    finally:
+        run_journal.set_current(None)
+    records, _ = read_journal(j.path)
+    abort = next(rec for rec in records if rec["event"] == "abort")
+    assert abort["exit_code"] == hb.EXIT_WATCHDOG
+    assert abort["collective"] == "hist_psum" and abort["iteration"] == 7
+    assert validate_record(abort) == []
+
+
+def test_collective_timing_sink_feeds_registry():
+    from lightgbm_tpu.parallel import heartbeat as hb
+    reg = MetricsRegistry()
+    hb.bind_timing_sink(lambda name, s: reg.observe("sync_wait_s", s))
+    try:
+        wd = hb.CollectiveWatchdog(30.0, rank=0)
+        with wd.armed("leaf_count_sync"):
+            time.sleep(0.01)
+    finally:
+        hb.bind_timing_sink(None)
+    h = reg.histogram("sync_wait_s")
+    assert h.count == 1 and h.last >= 0.01
+
+
+# ---------------------------------------------------------------- /trainz
+
+def test_trainz_endpoint_smoke(tmp_path):
+    tracer = SpanTracer()
+    with tracer.phase("build"):
+        pass
+    reg = MetricsRegistry()
+    reg.inc("tree_build_dispatches", 4)
+    j = RunJournal(str(tmp_path), rank=0)
+    j.iteration(3, phases={"build": 0.1})
+    srv = start_trainz(trainz.build_sources(
+        iteration_fn=lambda: 3, tracer=tracer, registry=reg, journal=j),
+        port=0)
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trainz", timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["iteration"] == 3
+        assert "build" in out["phases"]
+        assert out["metrics"]["counters"]["tree_build_dispatches"] == 4
+        assert out["journal_tail"][-1]["event"] == "iteration"
+        assert out["heartbeats"] is None   # no service running
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=30) as r:
+            pass
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        stop_trainz(srv)
+        j.close()
+
+
+def test_trainz_via_config_knob(tmp_path):
+    """`telemetry_port` wires the live endpoint to a real training
+    run's booster."""
+    bst = _train(tmp_path, "tz", n_rounds=3, telemetry_port=0)
+    # port 0 disables via config (0 = off); start explicitly instead
+    g = bst.gbdt
+    assert g._trainz_server is None
+    srv = start_trainz(trainz.build_sources(
+        iteration_fn=lambda: g.iter, tracer=g.tracer, registry=g.metrics,
+        journal=g.journal), port=0)
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trainz", timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["iteration"] == 3
+        assert out["journal_tail"]
+    finally:
+        stop_trainz(srv)
+
+
+# ------------------------------------------------------- serving /metricz
+
+def test_serving_metrics_parity_after_registry_refactor():
+    """ServingMetrics moved onto telemetry.registry: the public
+    attribute surface, percentile semantics, and the exact /metricz
+    field set must be unchanged (tests/test_serving.py pins behavior in
+    situ; this pins the contract directly)."""
+    from lightgbm_tpu.serving.metrics import ServingMetrics
+    m = ServingMetrics()
+    m.record_request(5, 0.002)
+    m.record_request(3, 0.004)
+    m.record_batch(8, 2)
+    m.record_error()
+    assert (m.request_count, m.rows_served, m.error_count) == (2, 8, 1)
+    assert (m.batch_count, m.batched_rows, m.batched_requests) == (1, 8, 2)
+    snap = m.snapshot()
+    assert set(snap) == {
+        "uptime_s", "request_count", "rows_served", "error_count",
+        "batch_count", "batch_occupancy_rows",
+        "batch_occupancy_requests", "latency_p50_ms", "latency_p95_ms",
+        "latency_p99_ms", "latency_window"}
+    assert snap["batch_occupancy_rows"] == pytest.approx(8.0)
+    assert snap["latency_p50_ms"] == pytest.approx(2.0)
+    assert snap["latency_window"] == 2
+    # registry view exposes the same counts (one source of truth)
+    reg = m.registry.snapshot()
+    assert reg["counters"]["request_count"] == 2
+    assert reg["histograms"]["latency_ms"]["count"] == 2
+
+
+# -------------------------------------------------------------- log modes
+
+def test_log_json_mode_and_rank_prefix(capsys, monkeypatch):
+    from lightgbm_tpu.utils.log import Log
+    monkeypatch.setenv("LIGHTGBM_TPU_LOG_JSON", "1")
+    Log.set_rank(1)
+    try:
+        Log.info("hello %d", 42)
+    finally:
+        Log.set_rank(None)
+    line = capsys.readouterr().out.strip()
+    rec = json.loads(line)
+    assert rec["level"] == "Info" and rec["msg"] == "hello 42"
+    assert rec["rank"] == 1
+    assert "T" in rec["ts"]   # ISO-8601
+
+
+def test_log_timestamp_mode(capsys, monkeypatch):
+    from lightgbm_tpu.utils.log import Log
+    monkeypatch.setenv("LIGHTGBM_TPU_LOG_TS", "1")
+    Log.info("stamped")
+    out = capsys.readouterr().out
+    assert out.startswith("[LightGBM-TPU] [2")   # ISO year prefix
+    assert "stamped" in out
+    monkeypatch.delenv("LIGHTGBM_TPU_LOG_TS")
+    Log.info("plain")
+    assert capsys.readouterr().out.startswith("[LightGBM-TPU] [Info]")
+
+
+# ----------------------------------------------------------- schema lint
+
+def test_check_journal_cli_flags_violations(tmp_path):
+    good = tmp_path / "journal.rank0000.jsonl"
+    rec = {"ts": time.time(), "event": "iteration", "rank": 0,
+           "iteration": 1}
+    good.write_text(json.dumps(rec) + "\n")
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(rec) + "\n"
+                   + '{"ts": 1.0, "event": "nope", "rank": 0}\n'
+                   + '{"torn...\n')
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    ok = subprocess.run([sys.executable, "tools/check_journal.py",
+                         str(tmp_path)], cwd=REPO, env=env,
+                        capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    fail = subprocess.run([sys.executable, "tools/check_journal.py",
+                           str(bad)], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert fail.returncode == 1
+    assert "unknown event" in fail.stderr
+    assert "torn/garbled" in fail.stderr

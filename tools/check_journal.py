@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Run-journal schema lint.
+
+Validates every record of one or more JSONL run journals
+(telemetry/journal.py) against the documented schema
+(docs/Observability.md): every line must parse as strict JSON and
+every record must carry the common fields plus its event's required
+fields with the right types. Unknown events fail; unknown extra
+fields pass (forward compatibility). The schema itself lives in
+`lightgbm_tpu.telemetry.journal.SCHEMA` — this tool is a thin CLI so
+the contract has exactly one source of truth.
+
+Usage:
+    python tools/check_journal.py <file-or-dir> [...]
+    python tools/check_journal.py --demo
+
+A directory argument validates every `journal.rank*.jsonl` plus the
+merged `journal.jsonl` inside it. `--demo` trains a tiny model with
+telemetry enabled into a temp dir and lints the journal it produced —
+the self-contained smoke `make verify-obs` runs.
+
+Exit codes: 0 = every record valid, 1 = violations found, 2 = usage /
+no journal files.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.telemetry import journal as run_journal  # noqa: E402
+
+
+def lint_file(path):
+    """Validate one journal file. Returns (n_records, [error strings])."""
+    errors = []
+    n = 0
+    try:
+        f = open(path, "r", encoding="utf-8")
+    except OSError as e:
+        return 0, [f"{path}: cannot open: {e}"]
+    with f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n += 1
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errors.append(f"{path}:{lineno}: torn/garbled line: {e}")
+                continue
+            for err in run_journal.validate_record(rec):
+                errors.append(f"{path}:{lineno}: {err}")
+    return n, errors
+
+
+def expand(paths):
+    """Arguments -> journal files (directories expand to their rank
+    files + merged journal)."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(run_journal.rank_files(p))
+            merged = os.path.join(p, run_journal.MERGED_NAME)
+            if os.path.exists(merged):
+                files.append(merged)
+        else:
+            files.append(p)
+    return files
+
+
+def run_demo():
+    """Train 3 iterations with telemetry on and lint the journal —
+    proves the writer honors the schema end to end."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    d = tempfile.mkdtemp(prefix="journal_demo_")
+    try:
+        rng = np.random.RandomState(7)
+        x = rng.rand(300, 4)
+        y = (x[:, 0] + x[:, 1] > 1).astype(float)
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "min_data_in_leaf": 10, "verbose": 0,
+                   "telemetry": True, "telemetry_dir": d},
+                  lgb.Dataset(x, y), num_boost_round=3)
+        rc = main([d])
+        print("demo journal lint:", "OK" if rc == 0 else "FAILED")
+        return rc
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def main(argv):
+    if not argv:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    if argv[0] == "--demo":
+        return run_demo()
+    files = expand(argv)
+    if not files:
+        print("check_journal: no journal files found under "
+              f"{argv}", file=sys.stderr)
+        return 2
+    total, all_errors = 0, []
+    for path in files:
+        n, errors = lint_file(path)
+        total += n
+        all_errors.extend(errors)
+        status = "OK" if not errors else f"{len(errors)} violation(s)"
+        print(f"{path}: {n} record(s): {status}")
+    for err in all_errors:
+        print(err, file=sys.stderr)
+    if all_errors:
+        print(f"check_journal: {len(all_errors)} violation(s) across "
+              f"{total} record(s)", file=sys.stderr)
+        return 1
+    print(f"check_journal: {total} record(s), all valid")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
