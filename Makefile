@@ -56,7 +56,15 @@ verify-obs:
 	  tests/test_telemetry.py -q
 	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_journal.py --demo
 
+# perf guardrail: the scaled CPU rung (warm compile cache) must stay
+# within 15% of the committed BENCH_BASELINE.json train time at an AUC
+# within 0.002, and the telemetry journal's phase deltas must sum back
+# to the tracer totals (tools/verify_perf.py)
+verify-perf:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py
+
 clean:
 	rm -f $(TARGET)
 
-.PHONY: all test-capi verify-fault verify-dist verify-serve verify-obs clean
+.PHONY: all test-capi verify-fault verify-dist verify-serve verify-obs \
+	verify-perf clean

@@ -4,9 +4,11 @@ Reference: include/LightGBM/dataset.h:278-421, src/io/dataset.cpp,
 include/LightGBM/dataset_loader.h, src/io/dataset_loader.cpp:162-941.
 
 TPU-first design: the training data is stored as ONE dense features-major
-integer matrix `bins` of shape (num_stored_rows, num_data) — uint8 when
-every stored row has <= 256 bins, else uint16 — pushed to device once and
-read by every histogram kernel. The reference's per-feature Bin objects
+integer matrix `bins` of shape (num_stored_rows, num_data) at its natural
+PACKED width (bins_dtype: uint8 when every stored row has <= 256 bins,
+int16 up to 32768, int32 as the escape) — pushed to device once and
+streamed at that width by every histogram kernel, so a per-split scan
+moves 1-2 bytes per cell instead of a widened int32's 4. The reference's per-feature Bin objects
 (dense/sparse/ordered variants, src/io/dense_bin.hpp / sparse_bin.hpp /
 ordered_sparse_bin.hpp) are CPU-cache layouts; on TPU one dense matrix
 feeds the MXU directly. Sparse data is handled by CAPACITY, not layout:
@@ -35,8 +37,30 @@ from .metadata import Metadata
 from .parser import parse_text_file, ZERO_THRESHOLD
 
 BINARY_MAGIC = "lightgbm_tpu_dataset_v1"
-BINARY_FORMAT_VERSION = 1
+# v2: bins persist at their natural PACKED width (uint8 <= 256 bins,
+# int16 above — the histogram engine's streaming contract, see
+# bins_dtype). v1 caches (uint8/uint16) still load, with uint16
+# narrowed to int16 on the way in; anything wider (a stale f32/int32
+# matrix from a foreign or pre-packing build) is rejected cleanly.
+BINARY_FORMAT_VERSION = 2
 _ZIP_MAGIC = b"PK\x03\x04"  # npz container prefix
+
+
+def bins_dtype(num_bins):
+    """Natural storage width of a bin matrix — the packed-bin contract
+    every loader path and the histogram kernels share: uint8 when every
+    stored row has <= 256 bins, int16 up to 32768 (TPU-native narrow
+    int; bin ids are non-negative so the sign bit is free), int32
+    beyond (unreachable under the reference's max_bin ceiling, kept as
+    a correctness escape)."""
+    if num_bins <= 256:
+        return np.uint8
+    if num_bins <= 32768:
+        return np.int16
+    return np.int32
+
+
+_BINS_CACHE_DTYPES = ("uint8", "uint16", "int16", "int32")
 
 
 class BinaryDatasetError(Exception):
@@ -191,7 +215,7 @@ def _bin_dense_on_device(mat, real_idx, mappers, dtype):
             x_used = np.nan_to_num(x_used, nan=0.0)
         xdev = jnp.asarray(x_used).reshape(n_pad // chunk, chunk, f)
         bdev = jnp.asarray(b32)
-        out_dt = jnp.uint8 if dtype == np.uint8 else jnp.uint16
+        out_dt = jnp.dtype(dtype)
 
         @jax.jit
         def bin_all(xc):
@@ -280,7 +304,7 @@ class CoreDataset:
     """Eagerly-binned dataset (the reference's `Dataset`, dataset.h:278-421)."""
 
     def __init__(self):
-        self.bins = None              # (F_used, N) uint8/uint16, host
+        self.bins = None              # (F_used, N) packed (bins_dtype), host
         self.bin_mappers = []         # per used feature
         self.used_feature_map = None  # (num_total_features,) int32: total->used or -1
         self.real_feature_idx = None  # (F_used,) int32: used -> total
@@ -477,6 +501,19 @@ class CoreDataset:
             raise BinaryDatasetError(
                 f"{path}: bins matrix has {ds.bins.ndim} dims, "
                 "expected 2", claimed=True)
+        if ds.bins.dtype.name not in _BINS_CACHE_DTYPES:
+            # a stale f32/f64/int64 matrix (foreign or pre-packing
+            # build) must not reach the histogram engine, which streams
+            # bins at their packed width
+            raise BinaryDatasetError(
+                f"{path}: bins matrix is {ds.bins.dtype.name}, expected "
+                f"a packed bin matrix ({'/'.join(_BINS_CACHE_DTYPES)}) — "
+                "stale or foreign cache", claimed=True)
+        natural = bins_dtype(int(ds.max_stored_bin))
+        if ds.bins.dtype != natural:
+            # v1 caches stored uint16 where the packed contract says
+            # int16; bin ids < max_stored_bin make the cast lossless
+            ds.bins = ds.bins.astype(natural)
         n_rows = int(ds.bins.shape[1])
         n_label = int(np.asarray(z["meta_label"]).shape[0])
         if n_label != n_rows:
@@ -790,15 +827,13 @@ class DatasetLoader:
             weights = qid = None
             bundle_conflicts = 0
         elif plan is None:
-            dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
-                     else np.uint16)
+            dtype = bins_dtype(max(m.num_bin for m in mappers))
             check_bins_budget(len(mappers), n_local,
                               np.dtype(dtype).itemsize,
                               "Dense (unbundled) streaming load")
             bins = np.empty((len(mappers), n_local), dtype=dtype)
         else:
-            dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
-                     else np.uint16)
+            dtype = bins_dtype(int(plan.slot_bins.max()))
             check_bins_budget(plan.num_slots, n_local,
                               np.dtype(dtype).itemsize,
                               "Bundled streaming load")
@@ -890,8 +925,7 @@ class DatasetLoader:
         cfg = self.config
         f_used = len(mappers)
         if plan is None:
-            dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
-                     else np.uint16)
+            dtype = bins_dtype(max(m.num_bin for m in mappers))
             check_bins_budget(f_used, n_local, np.dtype(dtype).itemsize,
                               "Dense (unbundled) sparse-LibSVM load")
             bins = np.zeros((f_used, n_local), dtype=dtype)
@@ -901,8 +935,7 @@ class DatasetLoader:
                 if b0:
                     bins[u, :] = b0
         else:
-            dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
-                     else np.uint16)
+            dtype = bins_dtype(int(plan.slot_bins.max()))
             check_bins_budget(plan.num_slots, n_local,
                               np.dtype(dtype).itemsize,
                               "Bundled sparse-LibSVM load")
@@ -1127,8 +1160,7 @@ class DatasetLoader:
                 plan = None
 
         if plan is None:
-            dtype = (np.uint8 if max(m.num_bin for m in mappers) <= 256
-                     else np.uint16)
+            dtype = bins_dtype(max(m.num_bin for m in mappers))
             check_bins_budget(len(real_idx), n, np.dtype(dtype).itemsize,
                               "Dense (unbundled) dataset construction")
             dev_bins = (_bin_dense_on_device(src._m,
@@ -1141,8 +1173,7 @@ class DatasetLoader:
                         src.col(real_idx[u])).astype(dtype),
                     len(real_idx)), axis=0)
         else:
-            dtype = (np.uint8 if int(plan.slot_bins.max()) <= 256
-                     else np.uint16)
+            dtype = bins_dtype(int(plan.slot_bins.max()))
             check_bins_budget(plan.num_slots, n, np.dtype(dtype).itemsize,
                               "Bundled dataset construction")
             ds.bins = build_stored_matrix(

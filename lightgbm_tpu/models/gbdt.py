@@ -743,6 +743,10 @@ class GBDT:
         else:
             grad_fn = self.objective._grad  # closure fallback
 
+        # the fused program embeds the learner's builder: resolve THIS
+        # learner's hist_mode for the trace (a sibling Booster may have
+        # moved the process global since learner init)
+        learner.apply_hist_mode()
         num_class = self.num_class
         # both the partitioned and the gather-compacted builders dispatch
         # histogram work through a bucketed lax.switch: vmapping them

@@ -40,7 +40,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.histogram import compacted_histograms
+import contextlib
+
+from ..ops.histogram import (callbacks_disabled, compacted_histograms,
+                             frontier_histograms, set_hist_mode)
 from ..ops.ordered_hist import canonical_row_chunks
 from ..ops.pallas_hist import masked_histograms, HIST_CHUNK
 from ..ops.split import SplitParams, find_best_split, K_MIN_SCORE
@@ -187,7 +190,7 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
                       hist_psum_fn=_collapse_pair, sum_psum_fn=_identity,
                       evaluate_fn=None, split_col_fn=None,
                       expand_fn=_identity, cache_hists=True,
-                      compact_hist=False):
+                      compact_hist=False, use_frontier=True):
     """Grow one leaf-wise tree on device. All shapes static.
 
     Args:
@@ -236,6 +239,15 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
         the whole array). Works under every collective hook: the pair
         contract is unchanged and the bucketed lax.switch holds no
         collectives, so hist_psum_fn still meets shards in lockstep.
+      use_frontier: route the root/bagging re-init pass through the
+        multi-leaf frontier primitive (ops/histogram.py
+        frontier_histograms), and — in cache-less (memory-bounded)
+        mode on the masked path — build BOTH children of a split in
+        one data pass instead of two, halving that mode's full-matrix
+        streams. Per-leaf values are bitwise identical to the
+        single-leaf kernels (same chunk decomposition and accumulation
+        order), so this changes pass count, not numerics. The
+        hist_frontier config tri-state maps here ("auto" = on).
 
     Returns a dict of tree arrays + the final row->leaf partition.
     """
@@ -261,12 +273,24 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
     # packed per-row stats, stats-major for the masked histogram kernel
     ghc_t = jnp.stack([g_in, h_in, inbag], axis=0)  # (3, N_pad)
 
+    # The masked (non-compacted) configuration is THE engine carrying
+    # the exact serial == data-parallel contract: its chunk kernels
+    # must resolve identically in the serial and meshed learners, and
+    # the meshed learners trace under callbacks_disabled (host
+    # callbacks deadlock multi-device shard_map CPU programs) — so the
+    # serial masked trace disables them too. The compacted engine
+    # (documented ~1e-6 vs masked, opt-in on row shards) keeps the
+    # bincount callback kernel.
+    hist_guard = (contextlib.nullcontext if compact_hist
+                  else callbacks_disabled)
+
     def full_scan_histogram(row_leaf, leaf_id):
         """Full-bandwidth streaming pass selecting `leaf_id`'s rows by
         mask (ops/pallas_hist.py) — the TPU replacement for the
         reference's ordered-gather ConstructHistogram."""
-        return masked_histograms(bins, ghc_t, row_leaf, leaf_id, b,
-                                 row_chunk)
+        with hist_guard():
+            return masked_histograms(bins, ghc_t, row_leaf, leaf_id, b,
+                                     row_chunk)
 
     if compact_hist:
         def leaf_histogram(row_leaf, leaf_id):
@@ -278,8 +302,18 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
         leaf_histogram = full_scan_histogram
 
     # ---- root ----------------------------------------------------------
+    # (re)built at every tree under bagging/GOSS: the in-bag weights
+    # rode in through ghc_t, so this full pass IS the bagging re-init
     row_leaf0 = jnp.zeros(n_pad, dtype=jnp.int32)
-    hist_root = hist_psum_fn(full_scan_histogram(row_leaf0, jnp.int32(0)))
+    if use_frontier:
+        with hist_guard():
+            root_pair = frontier_histograms(bins, ghc_t, row_leaf0,
+                                            jnp.zeros(1, jnp.int32), b,
+                                            row_chunk)
+        hist_root = hist_psum_fn(root_pair)[0]
+    else:
+        hist_root = hist_psum_fn(full_scan_histogram(row_leaf0,
+                                                     jnp.int32(0)))
     # root sums from the reduced histogram: feature 0's bins partition
     # the rows, so its bin sums ARE the leaf totals — this keeps parent
     # sums bit-consistent with the histogram across serial/parallel
@@ -332,6 +366,19 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
                 st["hist_cache"] = (st["hist_cache"]
                                     .at[best_leaf].set(hist_left)
                                     .at[right_id].set(hist_right))
+            elif use_frontier and not compact_hist:
+                # memory-bounded mode, frontier-batched: BOTH children
+                # from ONE streamed pass (leaf-indexed accumulator /
+                # combined leaf x bin key) — half the full-matrix
+                # streams of the two-pass recompute below
+                leaf_vec = jnp.stack([best_leaf,
+                                      right_id]).astype(jnp.int32)
+                with hist_guard():
+                    both_pair = frontier_histograms(
+                        bins, ghc_t, st["row_leaf"], leaf_vec, b,
+                        row_chunk)
+                both = hist_psum_fn(both_pair)
+                hist_left, hist_right = both[0], both[1]
             else:
                 # memory-bounded mode: both children recomputed
                 hist_left = hist_psum_fn(
@@ -401,8 +448,19 @@ class SerialTreeLearner:
         # several features' bin ranges; io/bundling.py)
         self.max_bin = int(train_set.max_stored_bin)
         self._bundle = train_set.bundle_plan
+        # histogram formulation knob (config wins over the env default;
+        # ops/histogram.py set_hist_mode) — must land before any
+        # builder jit so the resolved mode is baked consistently. The
+        # mode is re-asserted before every build/trace (apply_hist_mode)
+        # so two Boosters with different hist_mode in one process
+        # cannot cross-contaminate a later retrace (new shape bucket).
+        self._hist_mode_cfg = getattr(cfg, "hist_mode", "auto")
+        set_hist_mode(self._hist_mode_cfg)
         self._use_partitioned = self._partitioned_enabled(cfg)
         self._use_compact = self._compaction_enabled(cfg)
+        self._use_frontier = _tristate(
+            getattr(cfg, "hist_frontier", "auto"),
+            "hist_frontier") != "false"
         self._use_shape_bucketing = _tristate(
             getattr(cfg, "shape_bucketing", "auto"),
             "shape_bucketing") != "false"
@@ -699,6 +757,7 @@ class SerialTreeLearner:
             row_chunk=chunk,
             cache_hists=cache_hists,
             compact_hist=self._use_compact,
+            use_frontier=self._use_frontier,
         )
         if getattr(self, "_bundle", None) is None:
             return base
@@ -730,6 +789,13 @@ class SerialTreeLearner:
                 [mask, np.zeros(self.f_pad - self.num_features, bool)])
         return mask
 
+    def apply_hist_mode(self):
+        """Re-assert THIS learner's configured hist_mode on the process
+        global before a build call or fused-program trace (a jit retrace
+        on a new shape bucket resolves the mode at that moment, and a
+        sibling Booster may have moved it since init)."""
+        set_hist_mode(getattr(self, "_hist_mode_cfg", "auto"))
+
     def train_device(self, grad, hess, inbag=None):
         """Grow one tree entirely on device; NO host synchronization.
 
@@ -738,6 +804,7 @@ class SerialTreeLearner:
         (and whether) to pull anything to host — see models/gbdt.py
         LazyTree.
         """
+        self.apply_hist_mode()
         n, n_pad = self.num_data, self.n_pad
         grad = jnp.asarray(grad, dtype=jnp.float32)
         hess = jnp.asarray(hess, dtype=jnp.float32)

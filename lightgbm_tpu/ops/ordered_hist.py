@@ -211,13 +211,14 @@ def segment_histograms(words, ghc_t, begin, cnt, num_bins_total, f,
     cnt = jnp.maximum(cnt, 0).astype(jnp.int32)
     idx, c_first = cover_index(begin, cnt, n_chunks)
 
-    import os
     if interpret_backend is None:
-        # same escape hatch as ops/pallas_hist.py masked_histograms:
-        # force the XLA path on TPU if the kernel regresses (bench.py
-        # fallback ladder); an explicit interpret_backend wins
-        on_tpu = (jax.default_backend() == "tpu"
-                  and not os.environ.get("LIGHTGBM_TPU_DISABLE_PALLAS"))
+        # same dispatch as ops/pallas_hist.py masked_histograms: TPU
+        # with hist_mode auto/pallas runs the kernel; einsum/segment/
+        # bincount (or LIGHTGBM_TPU_DISABLE_PALLAS=1) force the XLA
+        # path (bench.py fallback ladder); an explicit
+        # interpret_backend wins
+        from .histogram import use_pallas
+        on_tpu = use_pallas()
     else:
         on_tpu = interpret_backend == "tpu"
 

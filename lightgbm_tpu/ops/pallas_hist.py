@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for masked gradient histograms — the hot op.
+"""Pallas TPU kernels for masked gradient histograms — the hot op.
 
 Reference semantics: the per-feature accumulation loops in
 src/io/dense_bin.hpp:16-195 / ordered_sparse_bin.hpp ConstructHistogram:
@@ -13,18 +13,34 @@ row->leaf map:
 
     hist[f, b, k] = sum_c [bins[f, c] == b] * [row_leaf[c] == leaf] * ghc[k, c]
 
-Per grid step (a row chunk C): bins (F, C) uint8, ghc (C, 3) f32 and
-row_leaf (1, C) int32 are DMA'd to VMEM (~(F+13)*C bytes — the one-hot
-never touches HBM). The one-hot is built as (B_pad, C): broadcasting
-the lane-resident bins row along SUBLANES is layout-native on the VPU
-(the (C, B) orientation would relayout lanes->sublanes per feature,
-measured 1.4x slower), and the (B_pad, C) @ (C, 3) dot is the natural
-MXU form. HBM traffic per histogram is bins + ghc + row_leaf (~44 MB at
-1M rows), two orders of magnitude below the einsum path; the kernel is
+Per grid step (a row chunk C): bins (F, C) at their NATURAL packed
+width (uint8 for <= 256 bins, int16 above — the DMA moves 1-2 bytes
+per cell, never a widened int32), ghc (C, 3) f32 and row_leaf (1, C)
+int32 are DMA'd to VMEM (~(F+13)*C bytes at uint8 — the one-hot never
+touches HBM). The one-hot is built as (B_pad, C): broadcasting the
+lane-resident bins row along SUBLANES is layout-native on the VPU (the
+(C, B) orientation would relayout lanes->sublanes per feature, measured
+1.4x slower), and the (B_pad, C) @ (C, 3) dot is the natural MXU form.
+HBM traffic per histogram is bins + ghc + row_leaf (~44 MB at 1M rows
+uint8), two orders of magnitude below the einsum path; the kernel is
 VPU-compare-bound, not bandwidth- or MXU-bound.
+
+The FRONTIER variant (frontier_histograms_tpu) carries a static vector
+of L leaf ids and a leaf-indexed (L, F, B_pad, 3) accumulator: the bin
+matrix streams ONCE for all L histograms (the multi-leaf primitive of
+docs/Histogram-Engine.md; compare cost grows with L, HBM traffic does
+not). VMEM bounds keep L small — the builder uses L = 2 (both children
+of a split) and L = 1 (root/bagging re-init).
 
 f32 operands give true f32 accumulation (better than XLA's default
 bfloat16 matmul passes); the count column comes out exactly integral.
+
+Dispatch: masked_histograms/frontier select the Pallas path via
+ops/histogram.py use_pallas() — TPU backend with hist_mode auto/pallas
+(config knob or LIGHTGBM_TPU_HIST_MODE). hist_mode=einsum/segment/
+bincount (or the legacy LIGHTGBM_TPU_DISABLE_PALLAS=1) forces the XLA
+fallback on TPU — the escape hatch for kernel regressions; bench.py
+uses it as a fallback rung.
 """
 
 import functools
@@ -37,6 +53,10 @@ from jax.experimental.pallas import tpu as pltpu
 # rows per grid step: the transient one-hot is (B_pad, CHUNK) f32 in
 # VMEM (4 MB at 256 x 4096); row padding must be a multiple of this.
 HIST_CHUNK = 4096
+
+# VMEM budget for a frontier kernel's (L, F, B_pad, 3) f32 accumulator;
+# larger frontiers fall back to per-leaf kernel calls.
+FRONTIER_VMEM_BYTES = 6 * 1024 * 1024
 
 
 def _hist_kernel(leaf_ref, bins_ref, ghc_ref, rl_ref, out_ref, *, f, b_pad):
@@ -58,12 +78,41 @@ def _hist_kernel(leaf_ref, bins_ref, ghc_ref, rl_ref, out_ref, *, f, b_pad):
             preferred_element_type=jnp.float32)                   # (B_pad, 3)
 
 
+def _frontier_kernel(leaves_ref, bins_ref, ghc_ref, rl_ref, out_ref,
+                     *, l, f, b_pad):
+    """Leaf-indexed accumulator: one streamed chunk feeds ALL l leaves'
+    histograms. Per chunk: l mask builds + l*f one-hot dots — compare
+    cost scales with l, HBM traffic does not."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    c = bins_ref.shape[1]
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (b_pad, c), 0)
+    for li in range(l):
+        mask = (rl_ref[0, :] == leaves_ref[li]).astype(jnp.float32)
+        ghc_m = ghc_ref[...] * mask[:, None]                      # (C, 3)
+        for i in range(f):
+            onehot = (bins_ref[i, :].astype(jnp.int32)[None, :]
+                      == b_iota).astype(jnp.float32)              # (B_pad, C)
+            out_ref[li, i, :, :] += jax.lax.dot_general(
+                onehot, ghc_m, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)               # (B_pad, 3)
+
+
+def _bin_pad(num_bins_total):
+    return max(((num_bins_total + 127) // 128) * 128, 128)
+
+
 def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
                           interpret=False):
     """hist[f, b, k] over rows with row_leaf == leaf_id (TPU kernel).
 
     Args:
-      bins: (F, N) uint8/uint16/int32 bin matrix, N % HIST_CHUNK == 0.
+      bins: (F, N) uint8/int16/int32 bin matrix, N % HIST_CHUNK == 0
+        (streamed at its stored width).
       ghc_t: (3, N) float32 stats (grad*inbag, hess*inbag, inbag).
       row_leaf: (N,) int32 row->leaf map.
       leaf_id: int32 scalar (traced ok).
@@ -74,7 +123,7 @@ def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
     f, n = bins.shape
     if n % HIST_CHUNK != 0:
         raise ValueError(f"N={n} must be a multiple of {HIST_CHUNK}")
-    b_pad = max(((num_bins_total + 127) // 128) * 128, 128)
+    b_pad = _bin_pad(num_bins_total)
     grid = (n // HIST_CHUNK,)
 
     kernel = functools.partial(_hist_kernel, f=f, b_pad=b_pad)
@@ -103,11 +152,56 @@ def masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
     return hist, jnp.zeros_like(hist)
 
 
+def frontier_histograms_tpu(bins, ghc_t, row_leaf, leaf_ids, num_bins_total,
+                            interpret=False):
+    """Multi-leaf kernel: (L, F, B, 3) over rows of each leaf in
+    `leaf_ids` (static length L, distinct ids) in ONE stream of the bin
+    matrix. Values are bitwise what L masked_histograms_tpu calls
+    produce (independent accumulators, same chunk order). Frontiers
+    whose accumulator exceeds FRONTIER_VMEM_BYTES fall back to per-leaf
+    kernel calls (still one stream per leaf)."""
+    l = leaf_ids.shape[0]
+    f, n = bins.shape
+    if n % HIST_CHUNK != 0:
+        raise ValueError(f"N={n} must be a multiple of {HIST_CHUNK}")
+    b_pad = _bin_pad(num_bins_total)
+    if l * f * b_pad * 3 * 4 > FRONTIER_VMEM_BYTES:
+        pairs = [masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_ids[i],
+                                       num_bins_total, interpret=interpret)
+                 for i in range(l)]
+        return (jnp.stack([p[0] for p in pairs]),
+                jnp.stack([p[1] for p in pairs]))
+    grid = (n // HIST_CHUNK,)
+
+    kernel = functools.partial(_frontier_kernel, l=l, f=f, b_pad=b_pad)
+    out = pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # leaf ids (L,)
+            pl.BlockSpec((f, HIST_CHUNK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((HIST_CHUNK, 3), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, HIST_CHUNK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((l, f, b_pad, 3), lambda i: (0, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((l, f, b_pad, 3), jnp.float32),
+    )(leaf_ids.astype(jnp.int32), bins, ghc_t.T, row_leaf.reshape(1, n))
+    hist = out[:, :, :num_bins_total, :]
+    return hist, jnp.zeros_like(hist)
+
+
 def masked_histograms_xla(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
                           row_chunk=HIST_CHUNK):
     """Reference XLA implementation (CPU tests / non-TPU backends): the
-    chunked one-hot einsum of ops/histogram.py with the leaf mask folded
-    into the stats. Returns a compensated (value, residual) pair."""
+    chunked histogram kernel of ops/histogram.py (bincount callback on
+    CPU, one-hot einsum elsewhere — chunk_mode) with the leaf mask
+    folded into the stats. Returns a compensated (value, residual)
+    pair."""
     from .histogram import build_histograms_pair
     mask = (row_leaf == leaf_id).astype(jnp.float32)
     ghc = (ghc_t * mask[None, :]).T
@@ -120,11 +214,11 @@ def masked_histograms(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
     collapse with `hist + residual`, or reduce the pair across shards in
     a fixed order first (parallel/learners.py pair_allreduce).
 
-    LIGHTGBM_TPU_DISABLE_PALLAS=1 forces the XLA path on TPU (escape
-    hatch for kernel regressions; bench.py uses it as a fallback)."""
-    import os
-    if (jax.default_backend() == "tpu"
-            and not os.environ.get("LIGHTGBM_TPU_DISABLE_PALLAS")):
+    hist_mode=einsum/segment/bincount (or LIGHTGBM_TPU_DISABLE_PALLAS=1)
+    forces the XLA path on TPU (escape hatch for kernel regressions;
+    bench.py uses it as a fallback)."""
+    from .histogram import use_pallas
+    if use_pallas():
         return masked_histograms_tpu(bins, ghc_t, row_leaf, leaf_id,
                                      num_bins_total)
     return masked_histograms_xla(bins, ghc_t, row_leaf, leaf_id,

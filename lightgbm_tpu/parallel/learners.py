@@ -268,7 +268,14 @@ class _MeshedTreeLearner(SerialTreeLearner):
     # (parallel/heartbeat.py; armed only when `collective_timeout_s`
     # is set, zero overhead otherwise).
     def train_device(self, grad, hess, inbag=None):
-        with collective_guard(f"{self.name}:tree_build"):
+        from ..ops.histogram import callbacks_disabled
+        # callbacks_disabled: the first call traces the jitted builder,
+        # and host-callback kernels inside multi-device shard_map
+        # programs deadlock this image's XLA CPU runtime — meshed
+        # builders bake the pure-XLA segment kernel instead
+        # (ops/histogram.py chunk_mode)
+        with collective_guard(f"{self.name}:tree_build"), \
+                callbacks_disabled():
             return super().train_device(grad, hess, inbag)
 
     def local_row_leaf(self, out, n_local):
@@ -362,6 +369,7 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
                 max_depth=max_depth, row_chunk=chunk,
                 hist_psum_fn=pair_allreduce,
                 compact_hist=self._use_compact,
+                use_frontier=self._use_frontier,
                 **self._bundle_kwargs(bins, num_bin_pf))
 
         return self._row_sharded_map(dp_fn)
@@ -456,6 +464,7 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
         max_depth = int(cfg.max_depth)
         f_loc = self.f_pad // self.n_shards
         compact = self._use_compact
+        use_frontier = self._use_frontier
 
         replicated = self._bins_replicated is not None
         bundled = getattr(self, "_bundle", None) is not None
@@ -538,7 +547,7 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
                 sum_psum_fn=sum_bcast,
                 evaluate_fn=evaluate, split_col_fn=split_col,
                 expand_fn=expand if bundled else (lambda h: h),
-                compact_hist=compact)
+                compact_hist=compact, use_frontier=use_frontier)
 
         def wrapped7(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
             inner = shard_map(
@@ -664,6 +673,7 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 sum_psum_fn=psum,
                 evaluate_fn=make_evaluate(fmask, num_bin_pf, is_cat),
                 compact_hist=self._use_compact,
+                use_frontier=self._use_frontier,
                 **self._bundle_kwargs(bins, num_bin_pf))
 
         return self._row_sharded_map(voting_fn)

@@ -210,9 +210,14 @@ from lightgbm_tpu.objectives import create_objective
 rng = np.random.RandomState(0)
 x = rng.rand(600, 4).astype(np.float32)
 y = (x[:, 0] > 0.5).astype(np.float32)
+# hist_mode=segment pins the PURE-XLA fused program: the CPU-default
+# bincount mode embeds host callbacks whose custom-call targets are
+# process-local, so that program can never be served across processes
+# (its cold compile is ~10x cheaper instead — the scatter/switch
+# graphs are gone; test_bincount_fused_compile_is_cheap below)
 cfg = Config.from_params({"objective": "binary", "num_leaves": 7,
                           "min_data_in_leaf": 5, "metric_freq": 0,
-                          "verbose": -1})
+                          "hist_mode": "segment", "verbose": -1})
 ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
 obj = create_objective(cfg.objective, cfg)
 obj.init(ds.metadata, ds.num_data)
@@ -254,3 +259,36 @@ def test_persistent_cache_skips_lowering_in_fresh_process(tmp_path):
     # still pays trace time, so assert a solid drop rather than zero
     assert second["compile_s"] < max(0.75 * first["compile_s"], 2.0), \
         (first, second)
+
+
+def test_bincount_fused_compile_is_cheap():
+    """The CPU-default bincount mode trades persistent-cache
+    serviceability of the fused program (host-callback custom-call
+    targets are process-local) for a fused compile that is cheap
+    enough not to need it: the scatter/switch graphs are gone from
+    the HLO. Pin that the whole warm-up stays well under the old
+    ~10 s cold compiles."""
+    import time
+
+    import numpy as np
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    rng = np.random.RandomState(1)
+    x = rng.rand(600, 4).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.float32)
+    cfg = Config.from_params({"objective": "binary", "num_leaves": 7,
+                              "min_data_in_leaf": 5, "metric_freq": 0,
+                              "hist_mode": "bincount", "verbose": -1})
+    ds = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    g = GBDT()
+    g.init(cfg, ds, obj, [])
+    t0 = time.time()
+    assert g.warm_up_fused(2)
+    assert time.time() - t0 < 8.0  # cold, single-core CI margin
+    g.train_many(2)
+    assert len(g.models) == 2

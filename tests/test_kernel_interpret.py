@@ -10,7 +10,10 @@ import jax.numpy as jnp
 
 from lightgbm_tpu.ops.ordered_hist import (pack_feature_words,
                                            segment_histograms)
-from lightgbm_tpu.ops.pallas_hist import (HIST_CHUNK, masked_histograms_tpu,
+from lightgbm_tpu.ops import pallas_hist
+from lightgbm_tpu.ops.pallas_hist import (HIST_CHUNK,
+                                          frontier_histograms_tpu,
+                                          masked_histograms_tpu,
                                           masked_histograms_xla)
 
 
@@ -46,6 +49,64 @@ def test_segment_kernel_interpret_matches_xla():
         want = want_fn(jnp.int32(begin), jnp.int32(cnt))
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-4)
+
+
+def test_masked_kernel_interpret_packed_int16():
+    """The packed-bin contract on the kernel: int16 bins (the > 256-bin
+    storage width) stream through the masked kernel unchanged — the
+    widening to int32 happens per-chunk in registers."""
+    rng = np.random.RandomState(2)
+    f, n, b = 4, 2 * HIST_CHUNK, 300
+    bins = rng.randint(0, b, size=(f, n)).astype(np.int16)
+    ghc_t = jnp.asarray(rng.rand(3, n).astype(np.float32))
+    row_leaf = jnp.asarray(rng.randint(0, 3, size=n).astype(np.int32))
+    got = jax.jit(lambda: masked_histograms_tpu(
+        jnp.asarray(bins), ghc_t, row_leaf, jnp.int32(2), b,
+        interpret=True))()[0]
+    want_hi, want_lo = jax.jit(lambda: masked_histograms_xla(
+        jnp.asarray(bins), ghc_t, row_leaf, jnp.int32(2), b))()
+    want = np.asarray(want_hi) + np.asarray(want_lo)
+    assert got.shape == (f, b, 3)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-4)
+
+
+def test_frontier_kernel_interpret_matches_masked():
+    """Multi-leaf kernel semantics: the leaf-indexed accumulator's
+    per-leaf slices equal the single-leaf masked kernel's output for
+    every frontier member (the builder mixes the two freely)."""
+    rng = np.random.RandomState(3)
+    f, n, b = 5, 2 * HIST_CHUNK, 16
+    bins = jnp.asarray(rng.randint(0, b, size=(f, n), dtype=np.uint8))
+    ghc_t = jnp.asarray(rng.rand(3, n).astype(np.float32))
+    row_leaf = jnp.asarray(rng.randint(0, 4, size=n).astype(np.int32))
+    leaf_ids = jnp.asarray([3, 0, 2], jnp.int32)
+    got, res = jax.jit(lambda: frontier_histograms_tpu(
+        bins, ghc_t, row_leaf, leaf_ids, b, interpret=True))()
+    assert got.shape == (3, f, b, 3)
+    assert np.asarray(res).max() == 0.0
+    for i, lid in enumerate([3, 0, 2]):
+        want = jax.jit(lambda lid=lid: masked_histograms_tpu(
+            bins, ghc_t, row_leaf, jnp.int32(lid), b,
+            interpret=True))()[0]
+        np.testing.assert_allclose(np.asarray(got[i]), np.asarray(want),
+                                   rtol=1e-5, atol=1e-4)
+
+
+def test_frontier_kernel_vmem_fallback(monkeypatch):
+    """A frontier whose accumulator would blow the VMEM budget falls
+    back to stacked per-leaf kernel calls with identical results."""
+    rng = np.random.RandomState(5)
+    f, n, b = 3, HIST_CHUNK, 16
+    bins = jnp.asarray(rng.randint(0, b, size=(f, n), dtype=np.uint8))
+    ghc_t = jnp.asarray(rng.rand(3, n).astype(np.float32))
+    row_leaf = jnp.asarray(rng.randint(0, 4, size=n).astype(np.int32))
+    leaf_ids = jnp.asarray([0, 1], jnp.int32)
+    full = jax.jit(lambda: frontier_histograms_tpu(
+        bins, ghc_t, row_leaf, leaf_ids, b, interpret=True))()[0]
+    monkeypatch.setattr(pallas_hist, "FRONTIER_VMEM_BYTES", 1)
+    fallback = jax.jit(lambda: frontier_histograms_tpu(
+        bins, ghc_t, row_leaf, leaf_ids, b, interpret=True))()[0]
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(fallback))
 
 
 def test_segment_kernel_interpret_bench_shape():

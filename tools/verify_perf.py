@@ -1,0 +1,145 @@
+"""Perf guardrail for the scaled CPU rung (`make verify-perf`).
+
+Three checks, any failure exits non-zero:
+
+1. **Train-time regression**: runs the bench's reduced CPU rung
+   (the committed baseline's shape) in a subprocess and fails when
+   train time regresses more than VERIFY_PERF_TOL (default 15%) over
+   BENCH_BASELINE.json. Compile happens outside the timed loop, so
+   one run is comparable.
+2. **AUC drift**: |AUC - baseline| must stay within 0.002 — a speedup
+   that moves accuracy is a regression, not a win.
+3. **Journal/tracer consistency**: trains a small run with telemetry
+   on and checks the journal's per-record phase DELTAS sum back to the
+   live tracer's totals (the reconstruction bench.py's `phases` dict
+   rests on), then schema-lints the journal via tools/check_journal.
+
+Usage: python tools/verify_perf.py  (from the repo root; CI wraps it in
+`timeout`, see the Makefile).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO, "BENCH_BASELINE.json")
+TOL = float(os.environ.get("VERIFY_PERF_TOL", "0.15"))
+AUC_TOL = 0.002
+
+
+def run_cpu_rung(rows, iters, timeout_s):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "BENCH_CHILD_CPU": "1",
+        "BENCH_CHILD_ROWS": str(rows),
+        "BENCH_CHILD_ITERS": str(iters),
+        "BENCH_SKIP_PREDICT": "1",
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    for line in r.stdout.splitlines():
+        if line.startswith("CHILD_RESULT "):
+            return json.loads(line.split(" ", 1)[1])
+    raise SystemExit("verify-perf: bench child produced no result "
+                     f"(rc={r.returncode}): {(r.stderr or '')[-400:]}")
+
+
+def check_speed():
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    rows, iters = int(base["n_rows"]), int(base["n_iters"])
+    timeout_s = int(os.environ.get("VERIFY_PERF_TIMEOUT", "420"))
+    # compile happens OUTSIDE the timed loop (bench.py warm_up_fused),
+    # so a single run is comparable to the committed baseline
+    res = run_cpu_rung(rows, iters, timeout_s)
+    limit = base["train_s"] * (1.0 + TOL)
+    ok_speed = res["time_s"] <= limit
+    ok_auc = abs(res["auc"] - base["auc"]) <= AUC_TOL
+    print(f"verify-perf: train {res['time_s']:.2f}s vs baseline "
+          f"{base['train_s']:.2f}s (limit {limit:.2f}s) -> "
+          f"{'OK' if ok_speed else 'REGRESSION'}")
+    print(f"verify-perf: auc {res['auc']:.5f} vs baseline "
+          f"{base['auc']:.5f} (tol {AUC_TOL}) -> "
+          f"{'OK' if ok_auc else 'DRIFT'}")
+    if res["phases"].get("hist_bytes_per_s"):
+        print(f"verify-perf: hist effective bandwidth "
+              f"{res['phases']['hist_bytes_per_s'] / 1e9:.2f} GB/s")
+    return ok_speed and ok_auc
+
+
+def check_journal_tracer_consistency():
+    """The journal's phase deltas must reconstruct the tracer totals —
+    train in-process so BOTH sides of the equality are observable."""
+    import shutil
+
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.telemetry.journal import read_journal
+    from tools.check_journal import main as lint_main
+
+    d = tempfile.mkdtemp(prefix="verify_perf_journal_")
+    try:
+        rng = np.random.RandomState(3)
+        x = rng.rand(600, 5)
+        y = (x[:, 0] + x[:, 1] > 1).astype(float)
+        booster = lgb.train({"objective": "binary", "num_leaves": 7,
+                             "min_data_in_leaf": 10, "verbose": 0,
+                             "telemetry": True, "telemetry_dir": d},
+                            lgb.Dataset(x, y), num_boost_round=4)
+        inner = booster.gbdt
+        totals = inner.tracer.snapshot()
+        records, bad = read_journal(inner.journal.path)
+        if bad:
+            print(f"verify-perf: journal has {bad} torn line(s)")
+            return False
+        sums = {}
+        for rec in records:
+            if rec.get("event") != "iteration":
+                continue
+            for name, secs in (rec.get("phases") or {}).items():
+                if isinstance(secs, (int, float)):
+                    sums[name] = sums.get(name, 0.0) + secs
+        ok = True
+        # the phases fully covered by iteration records (trailing
+        # activity after the last record would skew other names —
+        # same contract test_telemetry pins)
+        for name in ("build", "score_upd", "host_sync"):
+            total, want = sums.get(name, 0.0), totals.get(name, 0.0)
+            if abs(total - want) > max(1e-4, 0.02 * max(want, total)):
+                print(f"verify-perf: phase [{name}] journal sum "
+                      f"{total:.6f}s != tracer total {want:.6f}s")
+                ok = False
+        if not sums:
+            print("verify-perf: journal produced no phase deltas")
+            ok = False
+        if ok:
+            print("verify-perf: journal phase sums match tracer totals "
+                  "-> OK")
+        lint_rc = lint_main([d])
+        print("verify-perf: journal schema lint ->",
+              "OK" if lint_rc == 0 else "FAILED")
+        return ok and lint_rc == 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def main():
+    ok = check_speed()
+    ok = check_journal_tracer_consistency() and ok
+    if not ok:
+        print("verify-perf: FAILED")
+        return 1
+    print("verify-perf: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
