@@ -63,8 +63,18 @@ verify-obs:
 verify-perf:
 	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py
 
+# out-of-core suite: block-store build/validate/reuse, streamed-vs-
+# in-RAM bitwise parity across objectives/sampling, crash->resume,
+# corrupt-store detection — then the acceptance guard (bench ooc_probe
+# via tools/verify_perf.py --ooc: >=10x-resident dataset trains
+# bit-identical with >=60% prefetch overlap and bounded peak RSS)
+verify-ooc:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_out_of_core.py -q
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --ooc
+
 clean:
 	rm -f $(TARGET)
 
 .PHONY: all test-capi verify-fault verify-dist verify-serve verify-obs \
-	verify-perf clean
+	verify-perf verify-ooc clean

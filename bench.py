@@ -696,6 +696,152 @@ def serving_probe(booster, x):
     return out
 
 
+def run_ooc_child():
+    """Out-of-core probe child (one per mode, so `ru_maxrss` is a clean
+    per-mode peak): open the block store the parent built and train the
+    same workload either streaming (BENCH_OOC_MODE=ooc) or fully
+    in-RAM on the identical binning (mode=ram, masked engine — the
+    bit-parity reference). Prints one ``OOC_CHILD {json}`` line with
+    peak RSS, train seconds, a model digest for the parity check, and
+    (ooc mode) the prefetcher's overlap/wait/bytes counters."""
+    import hashlib
+    import resource
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import open_block_store_dataset
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+
+    mode = os.environ["BENCH_OOC_MODE"]
+    n_iters = int(os.environ.get("BENCH_OOC_ITERS", "2"))
+    params = {
+        "objective": "binary",
+        "num_leaves": int(os.environ.get("BENCH_OOC_LEAVES", "15")),
+        "max_bin": 255,
+        "learning_rate": 0.1,
+        "num_iterations": n_iters,
+        "metric": "auc",
+        # the parity pairing: streaming folds == masked engine
+        "hist_compaction": "false",
+        "partitioned_build": "false",
+        "device_row_chunk": int(os.environ.get("BENCH_OOC_CHUNK", "4096")),
+        "block_rows": int(os.environ.get("BENCH_OOC_BLOCK_ROWS", "4096")),
+        "out_of_core": mode == "ooc",
+    }
+    cfg = Config.from_params(params)
+    ds = open_block_store_dataset(os.environ["BENCH_OOC_DIR"])
+    n_rows = ds.num_data
+    if mode == "ram":
+        ds = ds.materialize_in_ram()
+    objective = create_objective(cfg.objective, cfg)
+    objective.init(ds.metadata, ds.num_data)
+    booster = GBDT()
+    booster.init(cfg, ds, objective, [])
+    booster.train_one_iter(is_eval=False)   # compile outside the window
+    booster.rollback_one_iter()
+    t0 = time.time()
+    for _ in range(n_iters):
+        booster.train_one_iter(is_eval=False)
+    np.asarray(booster.get_training_score())
+    train_s = time.time() - t0
+    res = {
+        "mode": mode, "rows": n_rows, "iters": n_iters,
+        "train_s": round(train_s, 3),
+        "rows_s": round(n_rows * n_iters / max(train_s, 1e-9), 1),
+        # linux ru_maxrss is KB
+        "peak_rss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1),
+        "model_sha": hashlib.sha256(
+            booster.save_model_to_string().encode()).hexdigest(),
+    }
+    if mode == "ooc":
+        pf = booster.tree_learner._prefetcher
+        res.update({k: v for k, v in pf.stats().items()})
+        res["resident_budget_mb"] = round(pf.resident_bytes() / 1e6, 2)
+    print("OOC_CHILD " + json.dumps(res), flush=True)
+
+
+def ooc_probe(timeout_s=600):
+    """Out-of-core acceptance probe (docs/Out-of-Core.md): build one
+    block store sized >= 10x the streaming pipeline's resident-block
+    budget, train it out-of-core and fully in-RAM on the same binning
+    in two fresh subprocesses, and report `ooc.rows_s`,
+    `ooc.prefetch_overlap_pct`, peak RSS of both modes, and the model
+    bit-parity verdict. tools/verify_perf.py guards these numbers."""
+    import tempfile
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.data import effective_block_rows, spill_core_dataset
+
+    n_rows = int(os.environ.get("BENCH_OOC_ROWS", "250000"))
+    d = tempfile.mkdtemp(prefix="bench_ooc_")
+    out = {}
+    try:
+        cfg = Config.from_params({
+            "max_bin": 255, "verbose": 0,
+            "device_row_chunk": int(os.environ.get("BENCH_OOC_CHUNK",
+                                                   "4096")),
+            "block_rows": int(os.environ.get("BENCH_OOC_BLOCK_ROWS",
+                                             "4096")),
+        })
+        _mark(f"ooc probe: building {n_rows}-row block store")
+        x, y = make_data(n_rows)
+        from lightgbm_tpu.io.dataset import DatasetLoader
+        core = DatasetLoader(cfg).construct_from_matrix(x, label=y)
+        ds = spill_core_dataset(core, d, effective_block_rows(cfg))
+        del core, x, y
+        out["rows"] = n_rows
+        out["blocks"] = ds.block_store.num_blocks
+        out["data_mb"] = round(ds.block_store.total_bytes() / 1e6, 2)
+        del ds
+
+        def run(mode):
+            env = dict(os.environ)
+            env.update({"BENCH_OOC_MODE": mode, "BENCH_OOC_DIR": d,
+                        "JAX_PLATFORMS": "cpu",
+                        "PALLAS_AXON_POOL_IPS": ""})
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--ooc-child"],
+                capture_output=True, text=True, timeout=timeout_s, env=env)
+            for line in r.stdout.splitlines():
+                if line.startswith("OOC_CHILD "):
+                    return json.loads(line.split(" ", 1)[1])
+            raise RuntimeError(
+                f"ooc child ({mode}) produced no result (rc="
+                f"{r.returncode}): {(r.stderr or '')[-300:]}")
+
+        _mark("ooc probe: streaming run")
+        ooc = run("ooc")
+        _mark("ooc probe: in-RAM reference run")
+        ram = run("ram")
+        out.update({
+            "iters": ooc["iters"],
+            "rows_s": ooc["rows_s"],
+            "train_s": ooc["train_s"],
+            "prefetch_overlap_pct": ooc["prefetch_overlap_pct"],
+            "prefetch_wait_s": ooc["prefetch_wait_s"],
+            "prefetch_gb": round(ooc["prefetch_bytes"] / 1e9, 3),
+            "resident_budget_mb": ooc["resident_budget_mb"],
+            "data_vs_resident": round(
+                out["data_mb"] / max(ooc["resident_budget_mb"], 1e-9), 1),
+            "peak_rss_mb": ooc["peak_rss_mb"],
+            "inram_peak_rss_mb": ram["peak_rss_mb"],
+            "rss_vs_inram": round(
+                ooc["peak_rss_mb"] / max(ram["peak_rss_mb"], 1e-9), 3),
+            "inram_train_s": ram["train_s"],
+            "bit_identical": ooc["model_sha"] == ram["model_sha"],
+        })
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"ooc probe failed: {e}")
+        out["error"] = str(e)[-250:]
+    finally:
+        import shutil
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def run_child():
     """Child mode: one isolated measurement. Env: BENCH_CHILD_ROWS,
     optional BENCH_CHILD_CPU / LIGHTGBM_TPU_DISABLE_PALLAS /
@@ -1002,6 +1148,9 @@ def _format_result(res, reason):
 
 
 def main():
+    if "--ooc-child" in sys.argv:
+        run_ooc_child()
+        return
     if "--child" in sys.argv:
         run_child()
         return
@@ -1015,6 +1164,14 @@ def main():
     # PRIMARY RESULT: printed and flushed immediately — nothing after
     # this line may lose it.
     print(json.dumps(result), flush=True)
+
+    # out-of-core acceptance probe (CPU subprocesses; cheap vs the
+    # rungs above): ooc.rows_s / ooc.prefetch_overlap_pct / peak-RSS
+    # vs the in-RAM baseline on identical binning
+    if not os.environ.get("BENCH_SKIP_OOC") and _remaining() > 240:
+        result["ooc"] = ooc_probe(
+            timeout_s=max(120, min(int(_remaining()) - 60, 600)))
+        print(json.dumps(result), flush=True)
 
     # On a real accelerator, also time the full HIGGS shape (north star)
     # — but not if even the 1M run had to fall back to CPU, and only

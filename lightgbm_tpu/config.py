@@ -289,6 +289,33 @@ class Config:
     # materialize as one matrix
     predict_chunk_rows: int = 65536
 
+    # --- out-of-core block-store training (lightgbm_tpu/data/; no
+    # reference equivalent — the reference caps datasets at host RAM).
+    # out_of_core=true bins the TRAIN dataset once into an on-disk
+    # packed-bin block store and trains by streaming blocks through a
+    # double-buffered async prefetcher (docs/Out-of-Core.md); trees are
+    # bit-identical to in-RAM training with the masked histogram engine
+    # (hist_compaction=false) on the same binning
+    out_of_core: bool = False
+    # rows per on-disk block; rounded up to a multiple of the histogram
+    # scan chunk (device_row_chunk) so block boundaries align with the
+    # Kahan chunk grid — the alignment the bitwise-parity contract
+    # rests on
+    block_rows: int = 262144
+    # decoded blocks kept resident in an LRU cache on top of the
+    # staging ring (0 = staging buffers only)
+    block_cache_blocks: int = 0
+    # staging buffers the background reader may fill ahead of the
+    # consumer; resident bin memory is bounded at (2*prefetch_depth + 1)
+    # blocks (staging ring + detached staged blocks in the queue + the
+    # one the consumer holds) plus the cache
+    prefetch_depth: int = 2
+    # block-store directory; default: "<data>.blocks" next to the data
+    # file, a fresh temp dir for in-memory matrices
+    ooc_dir: str = ""
+    # verify each block's manifest digest on its first read
+    ooc_verify: bool = True
+
     # derived
     is_parallel: bool = False
     is_parallel_find_bin: bool = False
@@ -469,6 +496,10 @@ class Config:
               "device_predict must be auto|true|false")
         check(self.predict_chunk_rows > 0,
               "predict_chunk_rows should be > 0")
+        check(self.block_rows > 0, "block_rows should be > 0")
+        check(self.block_cache_blocks >= 0,
+              "block_cache_blocks should be >= 0")
+        check(self.prefetch_depth >= 1, "prefetch_depth should be >= 1")
         check(str(self.hist_mode).lower() in
               ("auto", "pallas", "einsum", "segment", "bincount"),
               "hist_mode must be auto|pallas|einsum|segment|bincount")

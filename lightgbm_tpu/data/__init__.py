@@ -1,0 +1,20 @@
+"""Out-of-core quantized dataset subsystem (docs/Out-of-Core.md).
+
+- block_store: on-disk packed-bin blocks + manifest + sidecar
+  (bin once, stream forever); OutOfCoreDataset container.
+- prefetch: double-buffered async disk->host->device block pipeline.
+- ooc_learner: the streaming tree learner (bit-identical to in-RAM
+  masked-engine training on the same binning).
+"""
+
+from .block_store import (BlockStore, BlockStoreError, BlockStoreWriter,
+                          OutOfCoreDataset, build_block_store_from_file,
+                          effective_block_rows, load_or_build_block_store,
+                          open_block_store_dataset, spill_core_dataset)
+from .prefetch import BlockPrefetcher
+
+__all__ = ["BlockStore", "BlockStoreError", "BlockStoreWriter",
+           "OutOfCoreDataset", "BlockPrefetcher",
+           "build_block_store_from_file", "effective_block_rows",
+           "load_or_build_block_store", "open_block_store_dataset",
+           "spill_core_dataset"]

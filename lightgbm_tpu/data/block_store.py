@@ -1,0 +1,621 @@
+"""On-disk packed-bin block store: the out-of-core quantized dataset.
+
+The reference (and our in-RAM path) caps dataset size at one host's
+RAM — DatasetLoader materializes the full (F, N) bin matrix. Ou's
+out-of-core GPU boosting (arXiv:2005.09148) shows that block-compressed
+on-disk QUANTIZED data plus transfer/compute overlap recovers
+near-in-memory throughput, because the packed-bin representation
+(arXiv:1806.11248) makes the streamed working set 1-2 bytes per cell.
+
+Layout (one directory per store):
+
+- ``block-%05d.npy`` — one (num_stored, rows) C-order packed-bin array
+  per fixed-row-count block (`bins_dtype` ladder: uint8 <= 256 bins,
+  int16 above — the PR-6 streaming contract). Blocks are plain .npy so
+  readers share the same mapped-IO path as the binary dataset cache
+  (data/mmap_io.py): `np.load(mmap_mode="r")`, per-feature rows sliced
+  without touching the rest of the block.
+- ``sidecar.npz`` — everything else a CoreDataset carries: bin
+  mappers, metadata (label/weights/query — the per-block
+  gradient-ordered slices are assembled back into RAM-resident
+  metadata at open; scores and gradients are O(N * 4B), the bin matrix
+  is the term worth spilling), feature names and maps.
+- ``manifest.json`` — schema/format version, dtypes, per-block row
+  ranges + crc32 digests, the binning signature (max_bin, sample seed,
+  column roles) and source-file signature used to decide reuse vs
+  rebuild. Written LAST, atomically: a crash mid-build leaves no
+  manifest, never a store that lies.
+
+Every validation failure a truncated, bit-rotted or stale store can
+produce surfaces as a BlockStoreError naming the file and the defect —
+the same discipline as the binary dataset cache (io/dataset.py) and the
+checkpoint loader.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from ..utils.log import Log
+from .mmap_io import crc32_file
+
+MANIFEST_NAME = "manifest.json"
+SIDECAR_NAME = "sidecar.npz"
+BLOCK_MAGIC = "lightgbm_tpu_block_store"
+FORMAT_VERSION = 1
+
+
+class BlockStoreError(Exception):
+    """A block store failed validation (missing/corrupt/truncated block,
+    stale or foreign manifest)."""
+
+
+def _block_name(i):
+    return f"block-{i:05d}.npy"
+
+
+def _atomic_write_bytes(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _atomic_save_npy(path, arr):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, arr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def source_signature(filename):
+    """Reuse-or-rebuild identity of a text data file: path + size +
+    mtime (the binary cache trusts its sibling name the same way; the
+    block store is explicit so a silently swapped file cannot feed
+    stale blocks)."""
+    st = os.stat(filename)
+    return {"path": os.path.abspath(str(filename)),
+            "size": int(st.st_size), "mtime_ns": int(st.st_mtime_ns)}
+
+
+class BlockStoreWriter:
+    """Buffered block writer: append (num_stored, r) packed-bin column
+    slices in row order; full blocks flush to disk atomically."""
+
+    def __init__(self, directory, num_stored, dtype, block_rows):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        # a manifest from an earlier build must not coexist with a
+        # half-written replacement
+        stale = os.path.join(self.directory, MANIFEST_NAME)
+        if os.path.exists(stale):
+            os.remove(stale)
+        self.num_stored = int(num_stored)
+        self.dtype = np.dtype(dtype)
+        self.block_rows = int(block_rows)
+        self._buf = np.zeros((self.num_stored, self.block_rows), self.dtype)
+        self._fill = 0
+        self._blocks = []
+        self.num_rows = 0
+
+    def append(self, cols):
+        """cols: (num_stored, r) packed bins for the next r rows."""
+        cols = np.asarray(cols)
+        if cols.shape[0] != self.num_stored:
+            raise BlockStoreError(
+                f"append expects {self.num_stored} stored rows, got "
+                f"{cols.shape[0]}")
+        r = cols.shape[1]
+        off = 0
+        while off < r:
+            take = min(self.block_rows - self._fill, r - off)
+            self._buf[:, self._fill:self._fill + take] = \
+                cols[:, off:off + take]
+            self._fill += take
+            off += take
+            if self._fill == self.block_rows:
+                self._flush()
+
+    def _flush(self):
+        if self._fill == 0:
+            return
+        i = len(self._blocks)
+        name = _block_name(i)
+        path = os.path.join(self.directory, name)
+        _atomic_save_npy(path, np.ascontiguousarray(self._buf[:, :self._fill]))
+        self._blocks.append({
+            "file": name,
+            "rows": int(self._fill),
+            "row_start": int(self.num_rows),
+            "nbytes": int(os.path.getsize(path)),
+            "crc32": int(crc32_file(path)),
+        })
+        self.num_rows += self._fill
+        self._fill = 0
+
+    def finish(self, sidecar_arrays, source=None, binning=None):
+        """Flush the tail block, write the sidecar, then the manifest
+        (last — its presence IS the store's validity marker)."""
+        self._flush()
+        sidecar_path = os.path.join(self.directory, SIDECAR_NAME)
+        import io as _io
+        buf = _io.BytesIO()
+        np.savez(buf, **sidecar_arrays)
+        _atomic_write_bytes(sidecar_path, buf.getvalue())
+        manifest = {
+            "magic": BLOCK_MAGIC,
+            "format_version": FORMAT_VERSION,
+            "num_rows": int(self.num_rows),
+            "num_stored": int(self.num_stored),
+            "block_rows": int(self.block_rows),
+            "dtype": self.dtype.name,
+            "blocks": self._blocks,
+            "sidecar": {"nbytes": int(os.path.getsize(sidecar_path)),
+                        "crc32": int(crc32_file(sidecar_path))},
+            "source": source,
+            "binning": binning,
+        }
+        _atomic_write_bytes(
+            os.path.join(self.directory, MANIFEST_NAME),
+            json.dumps(manifest, indent=1).encode())
+        return manifest
+
+
+class BlockStore:
+    """Reader over a finished block-store directory."""
+
+    def __init__(self, directory, manifest, verify=True):
+        self.directory = str(directory)
+        self.manifest = manifest
+        self.num_rows = int(manifest["num_rows"])
+        self.num_stored = int(manifest["num_stored"])
+        self.block_rows = int(manifest["block_rows"])
+        self.dtype = np.dtype(manifest["dtype"])
+        self.blocks = manifest["blocks"]
+        self.num_blocks = len(self.blocks)
+        self.verify = bool(verify)
+        self._verified = set()
+
+    # ------------------------------------------------------------- open
+    @classmethod
+    def open(cls, directory, verify=True):
+        """Open + validate. BlockStoreError names every defect: missing
+        or foreign manifest, version skew, and per-block size mismatch
+        (a stale manifest over regenerated blocks, or a truncated
+        block)."""
+        directory = str(directory)
+        mpath = os.path.join(directory, MANIFEST_NAME)
+        if not os.path.exists(mpath):
+            raise BlockStoreError(
+                f"{directory} has no {MANIFEST_NAME} (not a block store, "
+                "or an interrupted build)")
+        try:
+            with open(mpath, "r") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise BlockStoreError(f"{mpath} is unreadable or not JSON: {e}")
+        if manifest.get("magic") != BLOCK_MAGIC:
+            raise BlockStoreError(
+                f"{mpath} has foreign magic {manifest.get('magic')!r} "
+                f"(expected {BLOCK_MAGIC})")
+        version = int(manifest.get("format_version", 0))
+        if version > FORMAT_VERSION:
+            raise BlockStoreError(
+                f"{directory} is block-store format {version}; this "
+                f"build reads up to {FORMAT_VERSION}")
+        for key in ("num_rows", "num_stored", "block_rows", "dtype",
+                    "blocks"):
+            if key not in manifest:
+                raise BlockStoreError(
+                    f"{mpath} is truncated (missing {key!r})")
+        rows = 0
+        for blk in manifest["blocks"]:
+            path = os.path.join(directory, blk["file"])
+            if not os.path.exists(path):
+                raise BlockStoreError(
+                    f"stale manifest: {blk['file']} listed in {mpath} "
+                    "does not exist")
+            size = os.path.getsize(path)
+            if size != int(blk["nbytes"]):
+                raise BlockStoreError(
+                    f"{blk['file']} is {size} bytes but the manifest "
+                    f"records {blk['nbytes']} — truncated block or "
+                    "stale manifest")
+            if int(blk["row_start"]) != rows:
+                raise BlockStoreError(
+                    f"stale manifest: {blk['file']} starts at row "
+                    f"{blk['row_start']}, expected {rows}")
+            rows += int(blk["rows"])
+        if rows != int(manifest["num_rows"]):
+            raise BlockStoreError(
+                f"stale manifest: blocks cover {rows} rows but the "
+                f"manifest records {manifest['num_rows']}")
+        return cls(directory, manifest, verify=verify)
+
+    # ------------------------------------------------------------ reads
+    def _block_path(self, i):
+        return os.path.join(self.directory, self.blocks[i]["file"])
+
+    def _verify_block(self, i):
+        if not self.verify or i in self._verified:
+            return
+        blk = self.blocks[i]
+        crc = crc32_file(self._block_path(i))
+        if crc != int(blk["crc32"]):
+            raise BlockStoreError(
+                f"{blk['file']} is corrupt (crc32 {crc:#010x} != "
+                f"manifest {int(blk['crc32']):#010x})")
+        self._verified.add(i)
+
+    def block_rows_of(self, i):
+        return int(self.blocks[i]["rows"])
+
+    def read_block(self, i):
+        """Read-only (num_stored, rows) memmap of block i (digest
+        verified on first touch). Maps are intentionally transient, not
+        cached on the store: munmap drops the block's touched pages
+        from the process RSS, which is what keeps the resident-memory
+        bound independent of how many blocks a pass visits."""
+        self._verify_block(i)
+        try:
+            mm = np.load(self._block_path(i), mmap_mode="r")
+        except Exception as e:
+            raise BlockStoreError(
+                f"{self.blocks[i]['file']} is unreadable ({e})")
+        want = (self.num_stored, self.block_rows_of(i))
+        if mm.shape != want or mm.dtype != self.dtype:
+            raise BlockStoreError(
+                f"{self.blocks[i]['file']} holds {mm.dtype}{mm.shape}, "
+                f"manifest says {self.dtype}{want} — stale manifest")
+        return mm
+
+    def read_block_into(self, i, out):
+        """Copy block i into `out[:, :rows]` (the prefetcher's staging
+        buffers); returns the row count."""
+        mm = self.read_block(i)
+        rows = mm.shape[1]
+        out[:, :rows] = mm
+        return rows
+
+    def feature_rows(self, i, feat):
+        """One stored feature's row of block i (a contiguous ~rows-byte
+        read through the memmap — the per-split partition update's
+        path)."""
+        return np.array(self.read_block(i)[int(feat)])
+
+    def load_sidecar(self):
+        path = os.path.join(self.directory, SIDECAR_NAME)
+        side = self.manifest.get("sidecar") or {}
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            raise BlockStoreError(f"{self.directory} has no {SIDECAR_NAME}")
+        if side and size != int(side.get("nbytes", size)):
+            raise BlockStoreError(
+                f"{SIDECAR_NAME} is {size} bytes but the manifest "
+                f"records {side.get('nbytes')} — stale manifest")
+        try:
+            return np.load(path, allow_pickle=True)
+        except Exception as e:
+            raise BlockStoreError(f"{SIDECAR_NAME} is unreadable ({e})")
+
+    def total_bytes(self):
+        return sum(int(b["nbytes"]) for b in self.blocks)
+
+
+class _BlockBinsView:
+    """Fancy-indexable [feat_arr, row_arr] view over the block store —
+    the host traversal path (Tree.get_leaf_by_bins) for DART
+    re-scoring, early-stop truncation and rollback, which index bins by
+    paired (feature, row) arrays. Rows are grouped by owning block and
+    gathered through each block's memmap."""
+
+    def __init__(self, store):
+        self._store = store
+        self.shape = (store.num_stored, store.num_rows)
+
+    def __getitem__(self, key):
+        feat, rows = key
+        feat = np.asarray(feat)
+        rows = np.asarray(rows)
+        feat, rows = np.broadcast_arrays(feat, rows)
+        out = np.zeros(feat.shape, dtype=np.int64)
+        blk = rows // self._store.block_rows
+        for b in np.unique(blk):
+            sel = blk == b
+            mm = self._store.read_block(int(b))
+            local = rows[sel] - int(b) * self._store.block_rows
+            out[sel] = mm[feat[sel], local].astype(np.int64)
+        return out
+
+
+# ----------------------------------------------------- dataset container
+
+from ..io.dataset import CoreDataset  # noqa: E402 (io.dataset never
+#                                       imports this module eagerly)
+
+
+class OutOfCoreDataset(CoreDataset):
+    """CoreDataset whose bin matrix lives in a block store. Mappers,
+    maps and metadata are RAM-resident; `bins` stays None, and the
+    paths that would need a resident matrix either stream (the
+    out-of-core learner), decode through the block view (host
+    traversal), or fail loudly (subset/cv, device_bins)."""
+
+    def __init__(self):
+        super().__init__()
+        self.block_store = None
+
+    @property
+    def num_data(self):
+        return 0 if self.block_store is None else self.block_store.num_rows
+
+    @property
+    def max_stored_bin(self):
+        return self.max_num_bin  # the block-store builder never bundles
+
+    @property
+    def stored_bins_dtype(self):
+        return self.block_store.dtype
+
+    def traversal_bins(self):
+        return _BlockBinsView(self.block_store)
+
+    def device_bins(self):
+        Log.fatal("out-of-core dataset has no resident bin matrix; "
+                  "bind it as the TRAIN set (valid sets stay in-RAM)")
+
+    def subset(self, indices):
+        Log.fatal("subset()/cv is not supported on an out-of-core "
+                  "dataset; train on the full block store")
+
+    def save_binary(self, path):
+        Log.fatal("save_binary is redundant for an out-of-core dataset: "
+                  "the block store at %s already is the binary form",
+                  self.block_store.directory if self.block_store else "?")
+
+    def materialize_in_ram(self):
+        """Read every block back into a resident CoreDataset (same
+        binning by construction) — the in-RAM reference half of parity
+        tests and bench's ooc_probe. Costs the full (F, N) matrix this
+        dataset exists to avoid; never called by training."""
+        store = self.block_store
+        core = CoreDataset()
+        core.bins = np.concatenate(
+            [np.array(store.read_block(i)) for i in range(store.num_blocks)],
+            axis=1)
+        core.bin_mappers = self.bin_mappers
+        core.used_feature_map = self.used_feature_map
+        core.real_feature_idx = self.real_feature_idx
+        core.feature_names = list(self.feature_names)
+        core.num_total_features = self.num_total_features
+        core.label_idx = self.label_idx
+        core.metadata = self.metadata
+        return core
+
+
+# --------------------------------------------------------------- sidecar
+
+def _sidecar_arrays(ds):
+    """CoreDataset-minus-bins as an npz dict — the binary cache's exact
+    entry set, through the shared encoder (io/dataset.py
+    encode_dataset_sidecar), so the two binary forms stay mutually
+    legible."""
+    from ..io.dataset import encode_dataset_sidecar
+    return encode_dataset_sidecar(ds)
+
+
+def _dataset_from_sidecar(z, store):
+    from ..io.dataset import decode_dataset_sidecar
+    ds = OutOfCoreDataset()
+    ds.block_store = store
+    decode_dataset_sidecar(
+        ds, z, lambda msg: BlockStoreError(f"sidecar is truncated ({msg})"))
+    if len(ds.metadata.label) != store.num_rows:
+        raise BlockStoreError(
+            f"sidecar label has {len(ds.metadata.label)} rows but the "
+            f"manifest records {store.num_rows} — stale store")
+    return ds
+
+
+# ----------------------------------------------------------- build paths
+
+def effective_block_rows(cfg):
+    """`block_rows` rounded UP to a multiple of the histogram scan
+    chunk (device_row_chunk), so block boundaries always land on the
+    Kahan chunk grid — the alignment the bitwise-parity contract rests
+    on (data/ooc_learner.py)."""
+    chunk = max(1, int(cfg.device_row_chunk))
+    want = max(1, int(cfg.block_rows))
+    rows = ((want + chunk - 1) // chunk) * chunk
+    if rows != want:
+        Log.warning("block_rows=%d rounded up to %d (a multiple of "
+                    "device_row_chunk=%d keeps block boundaries on the "
+                    "histogram chunk grid)", want, rows, chunk)
+    return rows
+
+
+def spill_core_dataset(core, directory, block_rows, verify=True):
+    """Write an in-RAM CoreDataset into a block store and return the
+    OutOfCoreDataset over it (the Python-API / matrix path; text files
+    stream block-by-block through build_block_store_from_file and never
+    materialize the matrix). The resident matrix is dropped from the
+    returned dataset."""
+    if core.bundle_plan is not None:
+        Log.fatal("out_of_core does not compose with feature bundling "
+                  "yet; set is_enable_sparse=false")
+    writer = BlockStoreWriter(directory, core.bins.shape[0],
+                              core.bins.dtype, block_rows)
+    r = int(block_rows)
+    for s in range(0, core.num_data, r):
+        writer.append(core.bins[:, s:s + r])
+    writer.finish(_sidecar_arrays(core))
+    store = BlockStore.open(directory, verify=verify)
+    ds = _dataset_from_sidecar(store.load_sidecar(), store)
+    Log.info("Spilled %d x %d bins to block store %s (%d blocks of %d "
+             "rows)", core.bins.shape[0], core.num_data, str(directory),
+             store.num_blocks, store.block_rows)
+    return ds
+
+
+def _binning_signature(cfg):
+    return {
+        "max_bin": int(cfg.max_bin),
+        "data_random_seed": int(cfg.data_random_seed),
+        "bin_construct_sample_cnt": int(cfg.bin_construct_sample_cnt),
+        "has_header": bool(cfg.has_header),
+        "label_column": str(cfg.label_column),
+        "weight_column": str(cfg.weight_column),
+        "group_column": str(cfg.group_column),
+        "ignore_column": str(cfg.ignore_column),
+        "categorical_column": str(cfg.categorical_column),
+    }
+
+
+def build_block_store_from_file(loader, filename, directory):
+    """Two-round streaming build straight into a block store: round one
+    samples rows and derives the bin mappers (identical draws — and
+    therefore identical mappers — to the in-memory path), round two
+    re-reads the file in parse blocks, bins each block and appends it
+    to the writer. Peak memory is O(parse block + store block +
+    metadata); the (F, N) matrix never exists."""
+    from ..io.dataset import bins_dtype, _qid_to_counts
+    from ..io.metadata import Metadata
+    from ..io.parser import detect_format
+    from ..io.streaming import (scan_file, iter_blocks, prefetch_blocks,
+                                collect_sample_rows)
+    from ..utils.random import Random
+    cfg = loader.config
+    fmt = detect_format(filename)
+    n, names, num_cols = scan_file(filename, fmt, cfg.has_header)
+    if n == 0:
+        Log.fatal("Data file %s is empty", str(filename))
+    label_idx = loader._resolve_label_idx(names, fmt)
+    feat_names = ([nm for i, nm in enumerate(names) if i != label_idx]
+                  if names is not None else None)
+    num_feats = num_cols - 1
+    feat_cols = np.asarray([j for j in range(num_cols) if j != label_idx])
+    weight_idx, group_idx, ignore, categorical = loader._resolve_columns(
+        feat_names, num_feats)
+    if weight_idx >= 0:
+        ignore.add(weight_idx)
+    if group_idx >= 0:
+        ignore.add(group_idx)
+
+    cnt = min(cfg.bin_construct_sample_cnt, n)
+    sample_idx = (np.arange(n, dtype=np.int64) if cnt == n
+                  else Random(cfg.data_random_seed).sample(n, cnt)
+                  .astype(np.int64))
+    sample_all = collect_sample_rows(filename, fmt, cfg.has_header,
+                                     num_cols, sample_idx)
+    sample_feats = sample_all[:, feat_cols]
+    mappers, used_map, real_idx = loader._make_mappers(
+        lambda j: sample_feats[:, j], num_feats, ignore, categorical)
+
+    # the in-RAM path would bundle here (EFB) and train on bundled
+    # slots; the block store bins per-feature, so a non-identity plan
+    # means out_of_core would silently train a DIFFERENT model — the
+    # same guard spill_core_dataset applies to a bundled matrix
+    if cfg.is_enable_sparse:
+        from ..io.bundling import plan_bundles
+        plan = plan_bundles(
+            mappers,
+            lambda u: mappers[u].value_to_bin(
+                sample_feats[:, real_idx[u]]),
+            enable=True, max_conflict_rate=cfg.max_conflict_rate)
+        if not plan.is_identity:
+            Log.fatal("out_of_core does not compose with feature "
+                      "bundling yet; set is_enable_sparse=false")
+
+    dtype = bins_dtype(max(m.num_bin for m in mappers))
+    writer = BlockStoreWriter(directory, len(mappers), dtype,
+                              effective_block_rows(cfg))
+    label = np.empty(n, dtype=np.float32)
+    weights = np.empty(n, dtype=np.float32) if weight_idx >= 0 else None
+    qid = np.empty(n, dtype=np.float64) if group_idx >= 0 else None
+    binned = None
+    for start, block in prefetch_blocks(
+            iter_blocks(filename, fmt, cfg.has_header, num_cols)):
+        end = start + len(block)
+        label[start:end] = block[:, label_idx]
+        feats_block = block[:, feat_cols]
+        if weights is not None:
+            weights[start:end] = feats_block[:, weight_idx]
+        if qid is not None:
+            qid[start:end] = feats_block[:, group_idx]
+        if binned is None or binned.shape[1] < len(block):
+            binned = np.empty((len(mappers), len(block)), dtype)
+        for u, j in enumerate(real_idx):
+            binned[u, :len(block)] = \
+                mappers[u].value_to_bin(feats_block[:, j]).astype(dtype)
+        writer.append(binned[:, :len(block)])
+
+    meta = Metadata(n)
+    meta.set_label(label)
+    if weights is not None:
+        meta.set_weights(weights)
+    if qid is not None:
+        meta.set_query(_qid_to_counts(qid))
+    meta.load_side_files(filename)
+
+    from ..io.dataset import CoreDataset
+    proto = CoreDataset()
+    proto.num_total_features = num_feats
+    proto.feature_names = (list(feat_names) if feat_names is not None
+                           else [f"Column_{i}" for i in range(num_feats)])
+    proto.bin_mappers = mappers
+    proto.used_feature_map = used_map
+    proto.real_feature_idx = np.asarray(real_idx, dtype=np.int32)
+    proto.label_idx = label_idx
+    proto.metadata = meta
+    writer.finish(_sidecar_arrays(proto),
+                  source=source_signature(filename),
+                  binning=_binning_signature(cfg))
+    Log.info("Built block store %s: %d rows x %d features, %d blocks "
+             "of %d rows (%s)", str(directory), n, len(mappers),
+             len(writer._blocks), writer.block_rows,
+             np.dtype(dtype).name)
+
+
+def open_block_store_dataset(directory, verify=True):
+    """Open a finished block-store directory as an OutOfCoreDataset —
+    no source file, no binning pass, O(sidecar + manifest) memory. The
+    API for training a store that some other process (or an earlier
+    run) already built."""
+    store = BlockStore.open(directory, verify=verify)
+    return _dataset_from_sidecar(store.load_sidecar(), store)
+
+
+def load_or_build_block_store(loader, filename):
+    """DatasetLoader's out-of-core entry: open the store next to the
+    data file when its manifest matches this (source, binning, block
+    geometry) signature; stream-rebuild otherwise."""
+    cfg = loader.config
+    directory = cfg.ooc_dir or (str(filename) + ".blocks")
+    want_src = source_signature(filename)
+    want_bin = _binning_signature(cfg)
+    store = None
+    if os.path.exists(os.path.join(directory, MANIFEST_NAME)):
+        try:
+            cand = BlockStore.open(directory, verify=cfg.ooc_verify)
+            if (cand.manifest.get("source") == want_src
+                    and cand.manifest.get("binning") == want_bin
+                    and cand.block_rows == effective_block_rows(cfg)):
+                store = cand
+                Log.info("Reusing block store %s (%d blocks)", directory,
+                         store.num_blocks)
+            else:
+                Log.warning("Block store %s was built from a different "
+                            "(source, binning, block_rows) signature; "
+                            "rebuilding", directory)
+        except BlockStoreError as e:
+            Log.warning("Ignoring unusable block store: %s", e)
+    if store is None:
+        build_block_store_from_file(loader, filename, directory)
+        store = BlockStore.open(directory, verify=cfg.ooc_verify)
+    return _dataset_from_sidecar(store.load_sidecar(), store)

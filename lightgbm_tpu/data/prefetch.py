@@ -1,0 +1,205 @@
+"""Double-buffered async block prefetch: disk -> staging -> device.
+
+The pipeline the out-of-core learner drives for every histogram pass
+(data/ooc_learner.py): a background reader thread copies the next
+blocks out of the store's memmaps into a fixed ring of preallocated
+staging buffers and stages them onto the default device, while the
+consumer folds the PREVIOUS block into the histogram carry — the
+transfer/compute overlap of Ou's out-of-core design (arXiv:2005.09148),
+with the bounded queue providing the backpressure the reference loader
+gets from its two-buffer swap (pipeline_reader.h:18-70, the same shape
+as io/streaming.py prefetch_blocks but recycling buffers across passes
+and counting its own overlap).
+
+Resident bin memory is bounded by construction: `depth` staging buffers
+(plus the device copy in flight) plus an optional LRU of
+`cache_blocks` decoded blocks. `stats()` exposes the counters the
+telemetry satellite surfaces per iteration: consumer wait seconds,
+producer busy (read+stage) seconds, bytes read, cache hits, and the
+overlap percentage ooc_probe asserts on (bench.py).
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+
+class BlockPrefetcher:
+    """Streams a fixed span plan (the learner's padded block geometry)
+    over and over — one `stream()` call per histogram pass."""
+
+    def __init__(self, store, spans, depth=2, cache_blocks=0,
+                 stage_to_device=True):
+        self.store = store
+        # spans: list of (block_idx, span_rows, data_rows); block_idx is
+        # None for virtual all-zero padding blocks past the data
+        self.spans = list(spans)
+        self.depth = max(1, int(depth))
+        self.cache_blocks = max(0, int(cache_blocks))
+        self.stage_to_device = stage_to_device
+        self._free = queue.Queue()
+        for _ in range(self.depth):
+            self._free.put(np.zeros((store.num_stored, store.block_rows),
+                                    store.dtype))
+        self._cache = {}        # span index -> staged block
+        self._cache_order = []
+        self._zero = {}         # span width -> shared all-zero staged block
+        # ------------------------------------------------ telemetry
+        self.wait_s = 0.0       # consumer blocked on the queue
+        self.read_s = 0.0       # producer busy (disk copy + device stage)
+        self.wall_s = 0.0       # histogram-pass wall incl. device sync
+        #                         (reported by the consumer, note_pass_wall)
+        self.bytes_read = 0
+        self.blocks_read = 0
+        self.cache_hits = 0
+        self.passes = 0
+
+    # ------------------------------------------------------------ helpers
+    def _stage(self, host_block):
+        if not self.stage_to_device:
+            return np.array(host_block)
+        import jax
+        return jax.device_put(host_block)
+
+    def _zero_span(self, width):
+        blk = self._zero.get(width)
+        if blk is None:
+            blk = self._stage(np.zeros((self.store.num_stored, width),
+                                       self.store.dtype))
+            self._zero[width] = blk
+        return blk
+
+    def _cache_put(self, key, blk):
+        if self.cache_blocks <= 0:
+            return
+        if key in self._cache:
+            return
+        self._cache[key] = blk
+        self._cache_order.append(key)
+        while len(self._cache_order) > self.cache_blocks:
+            evict = self._cache_order.pop(0)
+            self._cache.pop(evict, None)
+
+    def resident_bytes(self):
+        """Upper bound of bin bytes this pipeline keeps resident: the
+        disk-read ring (depth), up to depth detached staged blocks in
+        the bounded queue, the one the consumer holds, plus cache and
+        shared zero blocks."""
+        item = self.store.num_stored * self.store.block_rows \
+            * self.store.dtype.itemsize
+        return item * (2 * self.depth + 1 + len(self._cache)
+                       + len(self._zero))
+
+    # ------------------------------------------------------------- stream
+    def stream(self):
+        """Yield (row_start, row_end, staged_block) per span, in order.
+        `staged_block` is (num_stored, row_end - row_start) on the
+        default device; rows past the data are zero."""
+        self.passes += 1
+        q = queue.Queue(maxsize=self.depth)
+        end = object()
+        err = []
+
+        def produce():
+            try:
+                row = 0
+                for key, (bidx, span_rows, data_rows) in \
+                        enumerate(self.spans):
+                    cached = self._cache.get(key)
+                    if cached is not None:
+                        self.cache_hits += 1
+                        q.put((row, row + span_rows, cached))
+                        row += span_rows
+                        continue
+                    if bidx is None or data_rows == 0:
+                        q.put((row, row + span_rows,
+                               self._zero_span(span_rows)))
+                        row += span_rows
+                        continue
+                    # backpressure wait (a free staging buffer) is NOT
+                    # read time — only the disk copy + device stage
+                    # count toward the overlap denominator
+                    buf = self._free.get()
+                    t0 = time.perf_counter()
+                    rows = self.store.read_block_into(bidx, buf)
+                    if rows != data_rows:
+                        raise RuntimeError(
+                            f"block {bidx} holds {rows} rows, span plan "
+                            f"expects {data_rows}")
+                    if span_rows > rows:
+                        buf[:, rows:span_rows] = 0
+                    # DETACH from the ring buffer before staging:
+                    # jax.device_put can zero-copy-alias aligned host
+                    # memory (XLA CPU) and its transfer is async, so
+                    # staging the ring buffer directly would let the
+                    # next disk read overwrite bins a histogram fold is
+                    # still consuming — observed as nondeterministic
+                    # trees. The copy is the staging hop (disk buffer ->
+                    # pinned block), part of producer busy time.
+                    staged = self._stage(np.array(buf[:, :span_rows]))
+                    self._free.put(buf)   # detached: safe to recycle
+                    self.read_s += time.perf_counter() - t0
+                    self.bytes_read += rows * self.store.num_stored \
+                        * self.store.dtype.itemsize
+                    self.blocks_read += 1
+                    self._cache_put(key, staged)
+                    q.put((row, row + span_rows, staged))
+                    row += span_rows
+            except BaseException as e:
+                err.append(e)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="ooc-block-prefetch")
+        t.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                self.wait_s += time.perf_counter() - t0
+                if item is end:
+                    break
+                yield item
+        finally:
+            # early consumer exit: drain so the producer can finish
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    time.sleep(0.001)
+            t.join(timeout=10)
+        if err:
+            raise err[0]
+
+    # -------------------------------------------------------------- stats
+    def note_pass_wall(self, seconds):
+        """Consumer hook: wall seconds of one full histogram pass
+        INCLUDING the device sync on its result (data/ooc_learner.py
+        _leaf_hist). XLA dispatch is asynchronous, so the consumer-side
+        loop alone would not see compute time at all — the pass wall is
+        the denominator that makes overlap_pct mean 'share of the pass
+        NOT stalled on IO'."""
+        self.wall_s += float(seconds)
+
+    def overlap_pct(self):
+        """Share of histogram-pass wall time NOT spent blocked on the
+        prefetch queue: 100 when IO was fully hidden behind compute, 0
+        when every pass second was an IO stall. Falls back to the
+        producer-busy denominator until a consumer reports pass walls."""
+        denom = self.wall_s if self.wall_s > 0.0 else self.read_s
+        if denom <= 0.0:
+            return 100.0
+        return max(0.0, min(100.0, 100.0 * (1.0 - self.wait_s / denom)))
+
+    def stats(self):
+        return {
+            "prefetch_wait_s": round(self.wait_s, 6),
+            "prefetch_read_s": round(self.read_s, 6),
+            "prefetch_bytes": int(self.bytes_read),
+            "prefetch_blocks": int(self.blocks_read),
+            "prefetch_cache_hits": int(self.cache_hits),
+            "prefetch_overlap_pct": round(self.overlap_pct(), 2),
+        }

@@ -300,6 +300,60 @@ class CscColumns:
         return cls(colptr, row_of[order], vals[order], nrow, num_col)
 
 
+def encode_dataset_sidecar(ds, arrays=None):
+    """npz encoding of a CoreDataset MINUS its bin matrix: feature
+    maps, names, bin mappers, bundle plan, metadata. ONE encoder for
+    the two binary forms — the binary cache (save_binary, bins member
+    added by the caller) and the block-store sidecar
+    (data/block_store.py) — so their on-disk dictionaries cannot
+    drift apart."""
+    arrays = {} if arrays is None else arrays
+    arrays.update({
+        "used_feature_map": ds.used_feature_map,
+        "real_feature_idx": ds.real_feature_idx,
+        "num_total_features": np.asarray(ds.num_total_features),
+        "label_idx": np.asarray(ds.label_idx),
+        "feature_names": np.asarray(ds.feature_names, dtype=object),
+    })
+    for i, m in enumerate(ds.bin_mappers):
+        for k, v in m.to_dict().items():
+            arrays[f"mapper{i}_{k}"] = np.asarray(v)
+    if ds.bundle_plan is not None:
+        for k, v in ds.bundle_plan.to_dict().items():
+            arrays[f"bundle_{k}"] = np.asarray(v)
+    for k, v in ds.metadata.to_dict().items():
+        arrays[f"meta_{k}"] = np.asarray(v)
+    return arrays
+
+
+def decode_dataset_sidecar(ds, z, truncated):
+    """Inverse of encode_dataset_sidecar: populate `ds` (everything but
+    bins) from npz archive `z`. `truncated(msg)` builds the exception
+    to raise on a structurally incomplete archive — each binary form
+    keeps its own error type."""
+    ds.used_feature_map = z["used_feature_map"]
+    ds.real_feature_idx = z["real_feature_idx"]
+    ds.num_total_features = int(z["num_total_features"])
+    ds.label_idx = int(z["label_idx"])
+    ds.feature_names = [str(x) for x in z["feature_names"]]
+    n_used = len(ds.real_feature_idx)
+    mappers = []
+    for i in range(n_used):
+        d = {k[len(f"mapper{i}_"):]: z[k] for k in z.files
+             if k.startswith(f"mapper{i}_")}
+        if "num_bin" not in d:
+            raise truncated(f"missing bin mapper {i} of {n_used}")
+        mappers.append(BinMapper.from_dict(d))
+    ds.bin_mappers = mappers
+    bundle = {k[7:]: z[k] for k in z.files if k.startswith("bundle_")}
+    if bundle:
+        from .bundling import BundlePlan
+        ds.bundle_plan = BundlePlan.from_dict(bundle)
+    meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
+    ds.metadata = Metadata.from_dict(meta)
+    return ds
+
+
 class CoreDataset:
     """Eagerly-binned dataset (the reference's `Dataset`, dataset.h:278-421)."""
 
@@ -349,6 +403,13 @@ class CoreDataset:
     def num_bin_array(self):
         return np.asarray([m.num_bin for m in self.bin_mappers], dtype=np.int32)
 
+    @property
+    def stored_bins_dtype(self):
+        """dtype of the stored bin matrix — resolvable without a
+        resident matrix (the out-of-core dataset forwards its block
+        store's dtype), so valid sets can align against either form."""
+        return self.bins.dtype
+
     def feature_is_categorical(self):
         return np.asarray([m.bin_type == CATEGORICAL for m in self.bin_mappers])
 
@@ -389,32 +450,21 @@ class CoreDataset:
     # --------------------------------------------------------- binary cache
     def save_binary(self, path):
         """Binary cache (reference dataset.cpp:133-212)."""
-        arrays = {
-            "bins": self.bins,
-            "used_feature_map": self.used_feature_map,
-            "real_feature_idx": self.real_feature_idx,
-            "num_total_features": np.asarray(self.num_total_features),
-            "label_idx": np.asarray(self.label_idx),
-            "feature_names": np.asarray(self.feature_names, dtype=object),
-        }
-        for i, m in enumerate(self.bin_mappers):
-            for k, v in m.to_dict().items():
-                arrays[f"mapper{i}_{k}"] = np.asarray(v)
-        if self.bundle_plan is not None:
-            for k, v in self.bundle_plan.to_dict().items():
-                arrays[f"bundle_{k}"] = np.asarray(v)
-        for k, v in self.metadata.to_dict().items():
-            arrays[f"meta_{k}"] = np.asarray(v)
+        arrays = encode_dataset_sidecar(self, {"bins": self.bins})
         from ..utils.checkpoint import atomic_open
         # crash-atomic: a kill mid-save must never leave a truncated
         # cache where a valid one stood (the loader would fatal on it).
         # The archive streams to the tmp file (savez keeps the exact
         # path; no .npz suffix is appended to an open handle).
+        # UNCOMPRESSED members (np.savez = ZIP_STORED): the bins matrix
+        # sits contiguous inside the archive, so the loader maps it
+        # through the OS page cache (data/mmap_io.py) instead of
+        # materializing a second copy — and packed uint8/int16 bins
+        # barely deflate anyway.
         with atomic_open(path) as f:
-            np.savez_compressed(f, magic=np.asarray(BINARY_MAGIC),
-                                format_version=np.asarray(
-                                    BINARY_FORMAT_VERSION),
-                                **arrays)
+            np.savez(f, magic=np.asarray(BINARY_MAGIC),
+                     format_version=np.asarray(BINARY_FORMAT_VERSION),
+                     **arrays)
         Log.info("Saved binary dataset to %s", str(path))
 
     @classmethod
@@ -466,29 +516,20 @@ class CoreDataset:
                     f"{path} is truncated (missing entries: "
                     f"{', '.join(missing)})", claimed=True)
             ds = cls()
-            ds.bins = z["bins"]
-            ds.used_feature_map = z["used_feature_map"]
-            ds.real_feature_idx = z["real_feature_idx"]
-            ds.num_total_features = int(z["num_total_features"])
-            ds.label_idx = int(z["label_idx"])
-            ds.feature_names = [str(x) for x in z["feature_names"]]
-            n_used = len(ds.real_feature_idx)
-            ds.bin_mappers = []
-            for i in range(n_used):
-                d = {k[len(f"mapper{i}_"):]: z[k] for k in z.files
-                     if k.startswith(f"mapper{i}_")}
-                if "num_bin" not in d:
-                    raise BinaryDatasetError(
-                        f"{path} is truncated (missing bin mapper {i} "
-                        f"of {n_used})", claimed=True)
-                ds.bin_mappers.append(BinMapper.from_dict(d))
-            bundle = {k[7:]: z[k] for k in z.files
-                      if k.startswith("bundle_")}
-            if bundle:
-                from .bundling import BundlePlan
-                ds.bundle_plan = BundlePlan.from_dict(bundle)
-            meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
-            ds.metadata = Metadata.from_dict(meta)
+            # mapped-IO fast path: an uncompressed bins member is read
+            # through the OS page cache (np.memmap) instead of a full
+            # read() copy, so a warm cache load no longer doubles peak
+            # RSS (the mapper verifies the member's zip CRC itself,
+            # streamed). Compressed members (pre-mapped-IO
+            # savez_compressed caches) and anything unmappable —
+            # including a CRC mismatch — fall back to the copying load,
+            # which surfaces the legacy BadZipFile on a rotten cache.
+            from ..data.mmap_io import memmap_npz_member
+            mapped = memmap_npz_member(path, "bins.npy")
+            ds.bins = mapped if mapped is not None else z["bins"]
+            decode_dataset_sidecar(
+                ds, z, lambda msg: BinaryDatasetError(
+                    f"{path} is truncated ({msg})", claimed=True))
         except BinaryDatasetError:
             raise
         except Exception as e:
@@ -570,6 +611,25 @@ class DatasetLoader:
 
     def load_from_file(self, filename, rank=0, num_machines=1) -> CoreDataset:
         cfg = self.config
+        # out-of-core: bin once into the on-disk block store next to the
+        # data file (reused across runs via its manifest signature) and
+        # return the streaming dataset — the (F, N) matrix never
+        # materializes (lightgbm_tpu/data/, docs/Out-of-Core.md)
+        if getattr(cfg, "out_of_core", False):
+            if self.predict_fun is not None:
+                Log.fatal("out_of_core does not support continued "
+                          "training (init scores need resident raw "
+                          "values)")
+            if num_machines > 1:
+                Log.fatal("out_of_core is single-host; per-shard block "
+                          "stores arrive with the pod-scale mesh "
+                          "refactor")
+            if cfg.max_bad_rows > 0:
+                Log.warning("max_bad_rows=%d is not applied on the "
+                            "out-of-core streaming load path: malformed "
+                            "rows still abort the load", cfg.max_bad_rows)
+            from ..data.block_store import load_or_build_block_store
+            return load_or_build_block_store(self, filename)
         bin_path = str(filename) + ".bin"
         # the binary cache stores no raw values, which continued training
         # needs for init scores — fall back to the text path then
@@ -729,16 +789,7 @@ class DatasetLoader:
         if n == 0:
             Log.fatal("Data file %s is empty", str(filename))
 
-        # label column resolution (parser semantics)
-        label_idx = 0
-        if fmt != "libsvm" and cfg.label_column != "":
-            s = str(cfg.label_column)
-            if s.startswith("name:"):
-                if names is None or s[5:] not in names:
-                    Log.fatal("Could not find label column %s in data file", s[5:])
-                label_idx = names.index(s[5:])
-            else:
-                label_idx = int(s)
+        label_idx = self._resolve_label_idx(names, fmt)
         feat_names = ([nm for i, nm in enumerate(names) if i != label_idx]
                       if names is not None else None)
         num_feats = num_cols - 1
@@ -1025,7 +1076,7 @@ class DatasetLoader:
         ds.used_feature_map = train_ds.used_feature_map
         ds.real_feature_idx = train_ds.real_feature_idx
         ds.bundle_plan = train_ds.bundle_plan
-        ds.bins = bins.astype(train_ds.bins.dtype, copy=False)
+        ds.bins = bins.astype(train_ds.stored_bins_dtype, copy=False)
         meta = Metadata(n)
         meta.set_label(label)
         meta.load_side_files(filename)
@@ -1045,7 +1096,8 @@ class DatasetLoader:
             if reference is not None:
                 return self._bin_with_mappers(data, reference, meta)
             categorical = set(int(c) for c in categorical_features)
-            return self._construct(data, None, set(), categorical, meta)
+            return self._maybe_spill(
+                self._construct(data, None, set(), categorical, meta))
         data = np.ascontiguousarray(np.asarray(data, dtype=np.float32))
         data = np.nan_to_num(data, nan=0.0)
         meta = Metadata(data.shape[0])
@@ -1054,9 +1106,53 @@ class DatasetLoader:
         if reference is not None:
             return self._bin_with_mappers(data, reference, meta)
         categorical = set(int(c) for c in categorical_features)
-        return self._construct(data, None, set(), categorical, meta)
+        return self._maybe_spill(
+            self._construct(data, None, set(), categorical, meta))
+
+    def _maybe_spill(self, ds):
+        """out_of_core on the in-memory (matrix) path: spill the freshly
+        binned dataset into a block store and train from disk. Unlike
+        the file path (which streams and never materializes the matrix),
+        this bins in RAM first — it bounds TRAINING residency, not
+        construction's. `ooc_dir` picks the store directory; default is
+        a fresh temp dir (no reuse signature exists for an anonymous
+        matrix)."""
+        cfg = self.config
+        if not getattr(cfg, "out_of_core", False):
+            return ds
+        import tempfile
+        from ..data.block_store import effective_block_rows, spill_core_dataset
+        anonymous = not cfg.ooc_dir
+        directory = cfg.ooc_dir or tempfile.mkdtemp(
+            prefix="lightgbm_tpu_blocks_")
+        out = spill_core_dataset(ds, directory, effective_block_rows(cfg),
+                                 verify=cfg.ooc_verify)
+        if anonymous:
+            # an unnamed spill dir has no reuse identity — reclaim the
+            # full dataset's disk bytes when the dataset object dies
+            # instead of leaking them in /tmp run after run
+            import shutil
+            import weakref
+            weakref.finalize(out, shutil.rmtree, directory,
+                             ignore_errors=True)
+        return out
 
     # ------------------------------------------------------------ internals
+    def _resolve_label_idx(self, names, fmt):
+        """Label column resolution (parser semantics; LibSVM labels are
+        always column 0). Shared by the two-round streaming path and the
+        block-store builder (data/block_store.py)."""
+        cfg = self.config
+        if fmt == "libsvm" or cfg.label_column == "":
+            return 0
+        s = str(cfg.label_column)
+        if s.startswith("name:"):
+            if names is None or s[5:] not in names:
+                Log.fatal("Could not find label column %s in data file",
+                          s[5:])
+            return names.index(s[5:])
+        return int(s)
+
     def _resolve_columns(self, names, num_cols):
         """weight/group/ignore/categorical column resolution. Indices do not
         count the label column (config.h:116-131)."""
@@ -1207,20 +1303,21 @@ class DatasetLoader:
             # decode slots exactly like the train set's)
             from .bundling import build_stored_matrix
             check_bins_budget(ref_ds.bundle_plan.num_slots, src.n,
-                              ref_ds.bins.dtype.itemsize,
+                              ref_ds.stored_bins_dtype.itemsize,
                               "Bundled aligned (valid set) construction")
             ds.bins = build_stored_matrix(
                 ref_ds.bundle_plan,
                 lambda u: mappers[u].value_to_bin(src.col(real[u])),
-                ref_ds.bins.dtype)
+                ref_ds.stored_bins_dtype)
             ds.bundle_plan = ref_ds.bundle_plan
             ds.metadata = meta
             return ds
-        check_bins_budget(len(mappers), src.n, ref_ds.bins.dtype.itemsize,
+        check_bins_budget(len(mappers), src.n,
+                          ref_ds.stored_bins_dtype.itemsize,
                           "Aligned (valid set) dataset construction")
         cols = _bin_columns_threaded(
             lambda u: mappers[u].value_to_bin(
-                src.col(real[u])).astype(ref_ds.bins.dtype),
+                src.col(real[u])).astype(ref_ds.stored_bins_dtype),
             len(mappers))
         ds.bins = np.stack(cols, axis=0)
         ds.metadata = meta

@@ -655,10 +655,16 @@ class GBDT:
         if self.journal is not None:
             # norms are the per-iteration training-health proxy (a NaN
             # storm or divergence is visible before the guardrails
-            # fire); np transfer is (K, N) f32, telemetry-gated
+            # fire); np transfer is (K, N) f32, telemetry-gated.
+            # Learners with per-iteration IO telemetry (the out-of-core
+            # streaming learner's prefetch deltas) ride along through
+            # the journal_fields hook.
+            fields_fn = getattr(self.tree_learner, "journal_fields", None)
+            extra = fields_fn() if callable(fields_fn) else {}
             self._journal_iteration(grad_norm=self._rms(gradients),
                                     hess_norm=self._rms(hessians),
-                                    leaf_count=int(new_leaves))
+                                    leaf_count=int(new_leaves),
+                                    **(extra or {}))
         if is_eval:
             with self.tracer.phase("eval"):
                 return self.eval_and_check_early_stopping()

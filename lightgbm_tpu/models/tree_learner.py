@@ -424,6 +424,39 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
     }
 
 
+def cache_hists_fits(cfg, stored, max_bin):
+    """Whether the per-leaf histogram cache (the fixed-buffer
+    HistogramPool analog) fits the configured budget. The reference
+    LRU-pages histograms under histogram_pool_size MB
+    (feature_histogram.hpp:337-481); dynamic eviction is XLA-hostile,
+    so over budget we instead RECOMPUTE both children's histograms at
+    each split (no parent subtraction): memory drops from
+    O(num_leaves * F * B) to O(F * B), cost at most doubles.
+
+    ONE shared rule: cache-vs-recompute changes the f32 histogram
+    arithmetic (parent subtraction vs direct build), so the out-of-core
+    streaming learner must make the identical decision to the in-RAM
+    masked engine or its bit-parity contract breaks at configs near the
+    pool boundary (lightgbm_tpu/data/ooc_learner.py)."""
+    cache_mb = (int(cfg.num_leaves) * stored * max_bin * 3 * 4
+                ) / (1024.0 * 1024.0)
+    pool = float(cfg.histogram_pool_size)
+    if 0 <= pool < cache_mb:
+        Log.info("Histogram cache (%.0f MB at %d leaves x %d stored "
+                 "features x %d bins) exceeds histogram_pool_size="
+                 "%.0f MB: recomputing child histograms instead of "
+                 "caching for subtraction", cache_mb,
+                 int(cfg.num_leaves), stored, max_bin, pool)
+        return False
+    if pool < 0 and cache_mb > 4096:
+        Log.warning("Histogram cache needs %.0f MB of device memory "
+                    "(%d leaves x %d stored features x %d bins); set "
+                    "histogram_pool_size (MB) to cap it via "
+                    "recompute mode", cache_mb, int(cfg.num_leaves),
+                    stored, max_bin)
+    return True
+
+
 class SerialTreeLearner:
     """Host-side driver owning the jitted builder (tree_learner.h:19-71)."""
 
@@ -440,6 +473,10 @@ class SerialTreeLearner:
         setup_compilation_cache(config)
 
     def init(self, train_set):
+        if getattr(train_set, "block_store", None) is not None:
+            Log.fatal("the training data is an out-of-core block store "
+                      "but out_of_core=false; set out_of_core=true (or "
+                      "rebuild the dataset in-RAM)")
         self.train_set = train_set
         cfg = self.config
         self.num_features = train_set.num_features
@@ -697,31 +734,8 @@ class SerialTreeLearner:
         return {"expand_fn": self._bundle_expand_fn(), "decode_fn": decode}
 
     def _cache_hists(self, cfg):
-        """Whether the per-leaf histogram cache (the fixed-buffer
-        HistogramPool analog) fits the configured budget. The reference
-        LRU-pages histograms under histogram_pool_size MB
-        (feature_histogram.hpp:337-481); dynamic eviction is
-        XLA-hostile, so over budget we instead RECOMPUTE both children's
-        histograms at each split (no parent subtraction): memory drops
-        from O(num_leaves * F * B) to O(F * B), cost at most doubles."""
         stored = self._bins.shape[0] * (4 if self._use_partitioned else 1)
-        cache_mb = (int(cfg.num_leaves) * stored * self.max_bin * 3 * 4
-                    ) / (1024.0 * 1024.0)
-        pool = float(cfg.histogram_pool_size)
-        if 0 <= pool < cache_mb:
-            Log.info("Histogram cache (%.0f MB at %d leaves x %d stored "
-                     "features x %d bins) exceeds histogram_pool_size="
-                     "%.0f MB: recomputing child histograms instead of "
-                     "caching for subtraction", cache_mb,
-                     int(cfg.num_leaves), stored, self.max_bin, pool)
-            return False
-        if pool < 0 and cache_mb > 4096:
-            Log.warning("Histogram cache needs %.0f MB of device memory "
-                        "(%d leaves x %d stored features x %d bins); set "
-                        "histogram_pool_size (MB) to cap it via "
-                        "recompute mode", cache_mb, int(cfg.num_leaves),
-                        stored, self.max_bin)
-        return True
+        return cache_hists_fits(cfg, stored, self.max_bin)
 
     def _make_build_core(self, cfg, chunk):
         """The un-jitted builder closure — also consumed directly by the
@@ -867,7 +881,16 @@ class SerialTreeLearner:
 
 
 def create_tree_learner(learner_type, config):
-    """Factory (src/treelearner/tree_learner.cpp:8-19)."""
+    """Factory (src/treelearner/tree_learner.cpp:8-19). out_of_core=true
+    swaps the serial learner for the block-store streaming learner
+    (lightgbm_tpu/data/ooc_learner.py, docs/Out-of-Core.md)."""
+    if getattr(config, "out_of_core", False):
+        if learner_type != "serial":
+            Log.fatal("out_of_core=true requires tree_learner=serial "
+                      "(got %s); per-shard block stores arrive with the "
+                      "pod-scale mesh refactor", learner_type)
+        from ..data.ooc_learner import OutOfCoreTreeLearner
+        return OutOfCoreTreeLearner(config)
     if learner_type == "serial":
         return SerialTreeLearner(config)
     try:

@@ -230,6 +230,60 @@ def build_histograms_pair(bins, ghc, num_bins_total, row_chunk=DEFAULT_ROW_CHUNK
     return acc, -comp  # Kahan comp holds the NEGATIVE residual
 
 
+def hist_pair_fold_block(acc, comp, bins_blk, ghc_blk, num_bins_total,
+                         row_chunk=DEFAULT_ROW_CHUNK):
+    """Continue build_histograms_pair's Kahan chunk scan across a block
+    boundary: fold `bins_blk`'s chunks into the running (acc, comp)
+    carry and return the new carry. Because a chunk's f32 partial
+    depends only on the chunk's rows and the carry chain is strictly
+    sequential, folding row-ordered blocks whose boundaries land on the
+    chunk grid reproduces the single-pass scan BIT-FOR-BIT — the
+    out-of-core streaming engine's parity contract
+    (lightgbm_tpu/data/ooc_learner.py; collapse the final carry with
+    hist_pair_fold_collapse).
+
+    Args:
+      acc, comp: (F, B, K) float32 running Kahan value/compensation
+        (start both at zeros; `comp` is the NEGATIVE residual, Kahan's
+        internal convention — build_histograms_pair returns -comp).
+      bins_blk: (F, R) integer bins, R a multiple of row_chunk (or a
+        single chunk when R <= row_chunk).
+      ghc_blk: (R, K) float32 packed statistics.
+    """
+    f, n = bins_blk.shape
+    k = ghc_blk.shape[1]
+    if n <= row_chunk:
+        chunks = (bins_blk[None], ghc_blk[None])
+    else:
+        if n % row_chunk != 0:
+            raise ValueError(
+                f"block of {n} rows must be a multiple of the scan "
+                f"chunk {row_chunk}")
+        nchunks = n // row_chunk
+        chunks = (bins_blk.reshape(f, nchunks, row_chunk)
+                  .transpose(1, 0, 2),
+                  ghc_blk.reshape(nchunks, row_chunk, k))
+
+    def step(carry, xs):
+        acc, comp = carry
+        bc, gc = xs
+        h = _hist_chunk(bc, gc, num_bins_total)
+        y = h - comp
+        t = acc + y
+        comp = (t - acc) - y
+        return (t, comp), None
+
+    (acc, comp), _ = jax.lax.scan(step, (acc, comp), chunks)
+    return acc, comp
+
+
+def hist_pair_fold_collapse(acc, comp):
+    """Collapse a hist_pair_fold_block carry into the final histogram —
+    the same `value + (-residual)` f32 add as _collapse_pair applied to
+    build_histograms_pair's (acc, -comp) output."""
+    return acc + (-comp)
+
+
 def _chunk_bounds(n, row_chunk):
     """Chunk decomposition shared by the XLA scan and the bincount
     callback: one chunk when n <= row_chunk, else n/row_chunk chunks."""

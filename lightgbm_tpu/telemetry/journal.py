@@ -59,7 +59,11 @@ SCHEMA = {
                                "grad_norm": float, "hess_norm": float,
                                "leaf_count": int,
                                "compile_cache_hit": bool,
-                               "fused": bool}},
+                               "fused": bool,
+                               # out-of-core streaming (data/ooc_learner)
+                               "prefetch_wait_s": float,
+                               "prefetch_bytes": int,
+                               "prefetch_overlap_pct": float}},
     "metrics": {"required": {"iteration": int, "values": dict},
                 "optional": {}},
     "checkpoint": {"required": {"iteration": int, "path": str},

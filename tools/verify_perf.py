@@ -131,7 +131,66 @@ def check_journal_tracer_consistency():
         shutil.rmtree(d, ignore_errors=True)
 
 
+def check_ooc():
+    """Out-of-core acceptance guard (`make verify-ooc`; the bench's
+    ooc_probe in guard form): a block store >= ~10x the streaming
+    pipeline's resident budget must train end-to-end with (1) a model
+    BIT-IDENTICAL to in-RAM masked-engine training on the same binning,
+    (2) prefetch/compute overlap >= VERIFY_OOC_MIN_OVERLAP (default
+    60%), and (3) peak RSS no worse than the in-RAM run's by more than
+    VERIFY_OOC_RSS_SLACK (default 10% — the streamed matrix is small at
+    guard scale, so this asserts 'bounded', not a big win)."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ.setdefault("BENCH_OOC_ROWS",
+                          os.environ.get("VERIFY_OOC_ROWS", "250000"))
+    import bench
+    res = bench.ooc_probe(
+        timeout_s=int(os.environ.get("VERIFY_OOC_TIMEOUT", "480")))
+    if "error" in res:
+        print(f"verify-ooc: probe failed: {res['error']}")
+        return False
+    min_overlap = float(os.environ.get("VERIFY_OOC_MIN_OVERLAP", "60"))
+    rss_slack = float(os.environ.get("VERIFY_OOC_RSS_SLACK", "0.10"))
+    ok = True
+    print(f"verify-ooc: {res['rows']} rows x {res['iters']} iters, "
+          f"{res['blocks']} blocks, data {res['data_mb']:.1f} MB = "
+          f"{res['data_vs_resident']}x the {res['resident_budget_mb']} MB "
+          f"resident budget, {res['rows_s']:.0f} rows/s")
+    if not res.get("bit_identical"):
+        print("verify-ooc: streamed model != in-RAM masked-engine model "
+              "-> PARITY BROKEN")
+        ok = False
+    else:
+        print("verify-ooc: streamed model bit-identical to in-RAM -> OK")
+    overlap = res.get("prefetch_overlap_pct", 0.0)
+    if overlap < min_overlap:
+        print(f"verify-ooc: prefetch overlap {overlap:.1f}% < "
+              f"{min_overlap:.0f}% -> IO NOT HIDDEN")
+        ok = False
+    else:
+        print(f"verify-ooc: prefetch overlap {overlap:.1f}% "
+              f"(>= {min_overlap:.0f}%) -> OK")
+    ratio = res.get("rss_vs_inram", 99.0)
+    if ratio > 1.0 + rss_slack:
+        print(f"verify-ooc: peak RSS {res['peak_rss_mb']} MB is "
+              f"{ratio:.2f}x the in-RAM run's {res['inram_peak_rss_mb']} "
+              f"MB -> NOT BOUNDED")
+        ok = False
+    else:
+        print(f"verify-ooc: peak RSS {res['peak_rss_mb']} MB vs in-RAM "
+              f"{res['inram_peak_rss_mb']} MB ({ratio:.2f}x) -> OK")
+    return ok
+
+
 def main():
+    if "--ooc" in sys.argv:
+        if not check_ooc():
+            print("verify-ooc: FAILED")
+            return 1
+        print("verify-ooc: all checks passed")
+        return 0
     ok = check_speed()
     ok = check_journal_tracer_consistency() and ok
     if not ok:
