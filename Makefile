@@ -48,9 +48,12 @@ verify-serve:
 
 # observability suite: span tracer nesting/isolation, registry
 # thread-safety, journal atomicity across hard kills, multi-rank merge,
-# /trainz endpoint, /metricz parity — then the journal-schema lint on a
-# freshly generated journal (tools/check_journal.py --demo trains a
-# tiny run with telemetry on and validates every record)
+# /trainz + /metricz (JSON and Prometheus exposition), compile ledger,
+# roofline table, trace export — then the journal-schema lint + trace-
+# export roundtrip on a freshly generated journal (check_journal.py
+# --demo trains a tiny run with telemetry_trace on, validates every
+# record incl. memory/compile/spans, exports the trace and re-loads it
+# through the event-invariant check)
 verify-obs:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_telemetry.py -q

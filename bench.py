@@ -392,6 +392,14 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     phases.update(checkpoint_probe(booster, train_s))
     phases.update(supervisor_probe())
     phases.update(telemetry_probe(booster, train_s, n_iters))
+    # introspection-layer summary for the result JSON: what the run
+    # compiled (telemetry/ledger.py; verify_perf tracks the totals) and
+    # its memory watermarks (the >25% peak-memory regression gate)
+    from lightgbm_tpu.telemetry import ledger as tl_ledger
+    led = tl_ledger.LEDGER.snapshot(recent_n=0)
+    led.pop("recent", None)
+    booster.bench_introspection = {"compile_ledger": led,
+                                   **tl_ledger.sample_memory()}
     # the journal has been read into `phases`; don't leak its temp dir
     import shutil
     booster.close_telemetry()
@@ -890,6 +898,8 @@ def run_child():
            "hist_mode": hist_mode,
            "hist_kernel": "pallas" if use_pallas() else chunk_mode(),
            "phases": phases}
+    if getattr(booster, "bench_introspection", None):
+        res["introspection"] = booster.bench_introspection
     # a full boosting iteration at >=100k rows cannot run in <1 ms; a
     # smaller number means the tunnel served a memoized dispatch
     if n_rows >= 100_000 and train_s / max(n_iters, 1) < 1e-3:
@@ -1135,6 +1145,10 @@ def _format_result(res, reason):
         result["fallback_note"] = res["fallback_from"]
     if res.get("phases"):
         result["phases"] = res["phases"]
+    if res.get("introspection"):
+        # compile-ledger totals + memory watermarks (tentpole PR 8);
+        # verify_perf gates peak memory against BENCH_BASELINE.json
+        result["introspection"] = res["introspection"]
     if res.get("serving"):
         # serving.latency_p50_ms / serving.throughput_rows_s etc.
         # (serving_probe) — the online-inference trajectory across

@@ -403,6 +403,9 @@ class Application:
             self.boosting.save_model_to_file(-1, cfg.output_model)
         b = self.boosting
         if b.journal is not None:
+            # final memory/compile drain + span-ring dump land BEFORE
+            # run_end so that record stays the timeline's last event
+            b.finalize_introspection()
             b.journal.event("run_end", iterations=int(b.iter),
                             train_s=round(time.time() - start, 3))
             if jax.process_count() > 1:

@@ -260,6 +260,18 @@ class Config:
     # wrap tracer spans in jax.profiler.TraceAnnotation so host-side
     # phases line up with XLA device traces (`profile=1` workflow)
     telemetry_jax_annotations: bool = False
+    # dump the tracer's recent-span ring into the journal at close (a
+    # `spans` record) so `tools/export_trace.py` renders fine-grained
+    # per-thread slices next to the journal timeline
+    telemetry_trace: bool = False
+    # warn at end of run for histogram kernels whose live achieved
+    # bytes/s (telemetry/roofline.py) fall below this fraction of the
+    # measured STREAM copy peak; 0 = off
+    roofline_warn_fraction: float = 0.0
+    # serving: requests slower than this emit a structured slow-request
+    # log line (the `python -m lightgbm_tpu.serve --slow-request-ms`
+    # flag mirrors it); 0 = off
+    slow_request_ms: float = 1000.0
 
     # --- fault tolerance (utils/checkpoint.py; no reference equivalent) ---
     snapshot_freq: int = 0     # checkpoint every k iterations (0 = off)
@@ -487,6 +499,10 @@ class Config:
               "collective_timeout_s should be >= 0")
         check(self.max_restarts >= 0, "max_restarts should be >= 0")
         check(self.telemetry_port >= 0, "telemetry_port should be >= 0")
+        check(0.0 <= self.roofline_warn_fraction <= 1.0,
+              "roofline_warn_fraction in [0, 1]")
+        check(self.slow_request_ms >= 0,
+              "slow_request_ms should be >= 0")
         check(self.max_bad_rows >= 0, "max_bad_rows should be >= 0")
         check(self.device_predict_cells > 0,
               "device_predict_cells should be > 0")
@@ -574,6 +590,11 @@ def setup_compilation_cache(config=None):
     ~/.cache/lightgbm_tpu/jax_cache). Returns the active cache dir or
     None. Never fatal: an unwritable directory only costs the cache.
     """
+    # the compile ledger rides the same monitoring stream; installing
+    # it here covers every compile path (training learners AND the
+    # serving warmup both pass through this function)
+    from .telemetry.ledger import LEDGER
+    LEDGER.install()
     mode = str(getattr(config, "compile_cache", "auto") or "auto")
     if mode.lower() in ("off", "false", "0", "-", "none"):
         return None

@@ -437,6 +437,12 @@ class GBDT:
         not open a second journal."""
         self.tracer.jax_annotations = bool(
             getattr(config, "telemetry_jax_annotations", False))
+        # performance-introspection knobs (read again at close_telemetry;
+        # stored so a reset_parameter() rebuild keeps the latest values)
+        self._telemetry_trace = bool(getattr(config, "telemetry_trace",
+                                             False))
+        self._roofline_warn_fraction = float(
+            getattr(config, "roofline_warn_fraction", 0.0) or 0.0)
         if not getattr(config, "telemetry", False):
             return
         import weakref
@@ -476,10 +482,12 @@ class GBDT:
                 return gbdt.iter if gbdt is not None else -1
 
             self._trainz_server = trainz.start_trainz(
-                trainz.build_sources(iteration_fn=iteration_fn,
-                                     tracer=self.tracer,
-                                     registry=self.metrics,
-                                     journal=self.journal),
+                trainz.build_sources(
+                    iteration_fn=iteration_fn,
+                    tracer=self.tracer,
+                    registry=self.metrics,
+                    journal=self.journal,
+                    roofline_warn_fraction=self._roofline_warn_fraction),
                 port=port)
 
     def _journal_iteration(self, **fields):
@@ -492,16 +500,58 @@ class GBDT:
         self.journal.iteration(self.iter,
                                phases=self.tracer.delta_snapshot(),
                                **fields)
+        self._journal_introspection()
+
+    def _journal_introspection(self):
+        """Memory watermarks + newly-recorded jit lowerings, appended at
+        every iteration/block boundary (the cadence docs/Observability.md
+        documents). The sample is one /proc read + allocator-stats call
+        (~microseconds) and the ledger drain hands each compile to the
+        journal exactly once, so the boundary cost stays inside the <1%
+        telemetry overhead bar (bench telemetry_probe)."""
+        from ..telemetry import ledger
+        mem = ledger.sample_memory()
+        if mem:
+            self.journal.event("memory", iteration=int(self.iter), **mem)
+            for key, val in mem.items():
+                self.metrics.set(key, val)
+        for entry in ledger.LEDGER.drain():
+            self.journal.event("compile", label=entry["label"] or "jit",
+                               seconds=round(entry["seconds"], 6),
+                               cache_hit=bool(entry["cache_hit"]))
 
     @staticmethod
     def _rms(arr):
         a = np.asarray(arr, dtype=np.float64)
         return float(np.sqrt(np.mean(a * a))) if a.size else 0.0
 
+    def finalize_introspection(self):
+        """Final introspection drain: last memory/compile records, the
+        `telemetry_trace` span-ring dump, the roofline warning. The CLI
+        calls it BEFORE writing `run_end` so that record stays the
+        timeline's last event; close_telemetry runs it as a fallback
+        for the Python-API path (engine/bench write no run_end).
+        Once-only."""
+        if self.journal is None or getattr(self, "_introspection_done",
+                                           False):
+            return
+        self._introspection_done = True
+        self._journal_introspection()
+        if getattr(self, "_telemetry_trace", False):
+            # the recent-span ring as ONE journal record: the trace
+            # exporter (telemetry/export.py) renders it as
+            # fine-grained per-thread slices next to the timeline
+            self.journal.event("spans",
+                               epoch_ts=self.tracer.epoch_wall,
+                               spans=self.tracer.recent(n=None))
+        self._warn_roofline()
+
     def close_telemetry(self, merge=False):
-        """End-of-run hook: close the journal (after an optional rank-0
-        merge) and stop the /trainz thread. Safe to call twice."""
+        """End-of-run hook: drain the introspection layer (see
+        finalize_introspection), close the journal (after an optional
+        rank-0 merge) and stop the /trainz thread. Safe to call twice."""
         if self.journal is not None:
+            self.finalize_introspection()
             if merge:
                 run_journal.merge_journals(self.journal.directory)
             self.journal.close()
@@ -512,6 +562,25 @@ class GBDT:
             from ..telemetry import trainz
             trainz.stop_trainz(self._trainz_server)
             self._trainz_server = None
+
+    def _warn_roofline(self):
+        """End-of-run roofline check (`roofline_warn_fraction` knob):
+        name every histogram kernel whose live achieved bytes/s fell
+        below the configured fraction of the measured STREAM peak."""
+        frac = getattr(self, "_roofline_warn_fraction", 0.0)
+        if frac <= 0:
+            return
+        from ..telemetry import roofline
+        snap = roofline.TABLE.snapshot(warn_fraction=frac)
+        for name, k in (snap.get("kernels") or {}).items():
+            if k.get("below_peak_fraction"):
+                Log.warning(
+                    "roofline: kernel [%s] achieved %.2f GB/s = %.1f%% "
+                    "of the %.2f GB/s STREAM peak (< %.0f%% warn "
+                    "fraction; %d calls, %.3fs)", name,
+                    k["bytes_per_s"] / 1e9, k.get("pct_of_peak", 0.0),
+                    snap["peak_bytes_per_s"] / 1e9, 100.0 * frac,
+                    k["calls"], k["seconds"])
 
     # --------------------------------------------------------------- bagging
     def _bagging_device_fn(self):
@@ -820,8 +889,13 @@ class GBDT:
         fmasks = jnp.ones((num_iters, num_class, learner.f_pad), dtype=bool)
         iters = jnp.arange(num_iters, dtype=jnp.int32)
         from ..config import compile_cache_hits
+        from ..telemetry.ledger import LEDGER
         hits_before = compile_cache_hits()
-        compiled = jax.jit(fused).lower(score, fmasks, iters, data).compile()
+        # the compile ledger attributes this lowering to its shape
+        # bucket — the fused scan length is what keys recompiles
+        with LEDGER.label(f"fused_scan_{num_iters}it"):
+            compiled = jax.jit(fused).lower(score, fmasks, iters,
+                                            data).compile()
         # whether the persistent compile cache served this lowering —
         # surfaced by bench.py as phases.compile_cache_hit
         self.last_compile_cache_hit = compile_cache_hits() > hits_before

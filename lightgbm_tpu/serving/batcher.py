@@ -58,10 +58,16 @@ class MicroBatcher:
             # (narrow/wide rows) also concatenate with each other
             rows = canon(rows)
         fut = Future()
+        # request-trace timestamps (serving/server.py splits latency
+        # into queue-wait vs batch-compute from these): t_enqueue here,
+        # t_dispatch/t_done stamped by the worker BEFORE it resolves
+        # the future, so a woken waiter always sees all three
+        fut.t_enqueue = time.monotonic()
+        fut.t_dispatch = fut.t_done = None
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            self._queue.append((kind, rows, fut, time.monotonic()))
+            self._queue.append((kind, rows, fut, fut.t_enqueue))
             self._cond.notify()
         return fut
 
@@ -121,6 +127,7 @@ class MicroBatcher:
             if got is None:
                 return
             kind, batch = got
+            t_dispatch = time.monotonic()
             try:
                 # inside the try: ANY failure (even a concat shape
                 # mismatch) must fail this batch's futures, never kill
@@ -136,12 +143,16 @@ class MicroBatcher:
                 # errors are counted per REQUEST by whoever consumes the
                 # futures (the HTTP handler) — counting the batch here
                 # too would double-book one failure
+                t_done = time.monotonic()
                 for _, fut in batch:
+                    fut.t_dispatch, fut.t_done = t_dispatch, t_done
                     fut.set_exception(e)
                 continue
+            t_done = time.monotonic()
             if self.metrics is not None:
                 self.metrics.record_batch(rows.shape[0], len(batch))
             s = 0
             for r, fut in batch:
+                fut.t_dispatch, fut.t_done = t_dispatch, t_done
                 fut.set_result(out[s:s + r.shape[0]])
                 s += r.shape[0]

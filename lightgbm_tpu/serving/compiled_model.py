@@ -159,14 +159,18 @@ class CompiledPredictor:
         `stats["compile_cache_hits"]` counts how many did."""
         t0 = time.time()
         hits0 = compile_cache_hits()
+        from ..telemetry.ledger import LEDGER
         for b in self.buckets:
             xb = jnp.zeros((b, self.num_features), jnp.float32)
-            jax.block_until_ready(self._dispatch_leaf(xb))
-            self._warmed.add(("leaf", b))
-            if device_kernels:
-                jax.block_until_ready(self._dispatch_raw32(xb))
-                jax.block_until_ready(self._dispatch_transformed32(xb))
-                self._warmed.update((("raw32", b), ("tr32", b)))
+            # the compile ledger attributes each bucket's lowering(s):
+            # /metricz shows which row bucket cost the warmup time
+            with LEDGER.label(f"serving_bucket_{b}"):
+                jax.block_until_ready(self._dispatch_leaf(xb))
+                self._warmed.add(("leaf", b))
+                if device_kernels:
+                    jax.block_until_ready(self._dispatch_raw32(xb))
+                    jax.block_until_ready(self._dispatch_transformed32(xb))
+                    self._warmed.update((("raw32", b), ("tr32", b)))
         self.stats["warmup_s"] = round(time.time() - t0, 3)
         self.stats["compile_cache_hits"] = compile_cache_hits() - hits0
         Log.info("CompiledPredictor warm: %d trees, %d buckets (max %d "

@@ -26,18 +26,26 @@ the serving layer must not add dependencies the training image lacks.
 
 import argparse
 import json
+import re
 import signal
 import sys
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 import numpy as np
 
 from ..io.parser import NA_VALUES
+from ..telemetry import prometheus
 from ..utils.log import Log
 from .batcher import MicroBatcher
 from .compiled_model import DEFAULT_MAX_BATCH_ROWS, CompiledPredictor
 from .metrics import ServingMetrics
+
+DEFAULT_SLOW_REQUEST_MS = 1000.0
+
+_REQUEST_ID_OK = re.compile(r"[^\w.\-]")
 
 
 def _parse_rows(body, content_type):
@@ -77,39 +85,90 @@ class ServingHandler(BaseHTTPRequestHandler):
     batcher = None
     metrics = None
     predictor = None
+    slow_request_ms = DEFAULT_SLOW_REQUEST_MS
 
-    def log_message(self, fmt, *args):  # route access logs through ours
+    def log_message(self, fmt, *args):
+        # the structured access-log record (one per request, with id +
+        # latency split) replaces the default per-line noise; keep the
+        # raw lines reachable at debug for protocol-level forensics
         Log.debug("http: " + fmt, *args)
 
-    def _reply(self, code, obj):
+    def _request_id(self):
+        """Caller's X-Request-Id (sanitized, bounded) or a fresh one —
+        either way the response echoes it, so a slow request is
+        greppable across client logs, access log and headers."""
+        rid = _REQUEST_ID_OK.sub("", self.headers.get("X-Request-Id")
+                                 or "")[:64]
+        return rid or uuid.uuid4().hex[:16]
+
+    def _reply(self, code, obj, headers=None):
         data = json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for name, value in (headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
 
+    def _access_log(self, request_id, rows, status, timing_ms):
+        """One structured record per request (request id, path, rows,
+        status, latency split) — a JSON object under
+        LIGHTGBM_TPU_LOG_JSON, key=value text otherwise."""
+        Log.structured("Info", "access", request_id=request_id,
+                       path=self.path.split("?")[0], rows=int(rows),
+                       status=int(status), **(timing_ms or {}))
+
+    def _metricz_snapshot(self):
+        snap = self.metrics.snapshot()
+        snap["queue_depth"] = self.batcher.queue_depth()
+        stats = self.predictor.stats
+        snap["warmup_s"] = stats["warmup_s"]
+        snap["compile_cache_hits"] = stats["compile_cache_hits"]
+        # True when AOT warmup was served by the persistent compile
+        # cache (warm-process startup; config.py)
+        snap["compile_cache_hit"] = stats["compile_cache_hits"] > 0
+        snap["warm_dispatches"] = stats["warm_dispatches"]
+        snap["cold_dispatches"] = stats["cold_dispatches"]
+        snap["buckets"] = stats["buckets"]
+        return snap
+
+    def _prometheus(self):
+        """The serving registry + the derived scalars (occupancy,
+        queue depth, warmup stats) in text exposition format — the
+        same page shape the training-side /metricz serves."""
+        reg = self.metrics.registry.snapshot()
+        owned = (set(reg.get("counters") or ())
+                 | set(reg.get("gauges") or ())
+                 | set(reg.get("histograms") or ()))
+        extra = {k: v for k, v in self._metricz_snapshot().items()
+                 if k not in owned
+                 and isinstance(v, (int, float))
+                 and not isinstance(v, bool)}
+        return prometheus.render(reg, extra_gauges=extra)
+
     def do_GET(self):
-        if self.path.startswith("/healthz"):
+        parts = urlsplit(self.path)
+        fmt = (parse_qs(parts.query).get("format") or [""])[0]
+        if parts.path.startswith("/healthz"):
             self._reply(200, {"status": "ok",
                               "model": self.predictor.describe()})
-        elif self.path.startswith("/metricz"):
-            snap = self.metrics.snapshot()
-            snap["queue_depth"] = self.batcher.queue_depth()
-            stats = self.predictor.stats
-            snap["warmup_s"] = stats["warmup_s"]
-            snap["compile_cache_hits"] = stats["compile_cache_hits"]
-            # True when AOT warmup was served by the persistent compile
-            # cache (warm-process startup; config.py)
-            snap["compile_cache_hit"] = stats["compile_cache_hits"] > 0
-            snap["warm_dispatches"] = stats["warm_dispatches"]
-            snap["cold_dispatches"] = stats["cold_dispatches"]
-            snap["buckets"] = stats["buckets"]
-            self._reply(200, snap)
+        elif parts.path.startswith("/metricz"):
+            if fmt == "prometheus":
+                data = self._prometheus().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", prometheus.CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._reply(200, self._metricz_snapshot())
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
+        req_id = self._request_id()
+        id_hdr = {"X-Request-Id": req_id}
         # drain the body BEFORE any reply: on an HTTP/1.1 keep-alive
         # connection unread body bytes would be parsed as the next
         # request line, poisoning the client's next call
@@ -117,44 +176,85 @@ class ServingHandler(BaseHTTPRequestHandler):
                          or "").lower():
             self.close_connection = True  # un-drainable without a length
             self._reply(411, {"error": "chunked bodies not supported; "
-                                       "send Content-Length"})
+                                       "send Content-Length",
+                              "request_id": req_id}, id_hdr)
+            self._access_log(req_id, 0, 411, None)
             return
         try:
             length = int(self.headers.get("Content-Length", 0) or 0)
         except ValueError:
             self.close_connection = True  # length unknown: can't drain
-            self._reply(400, {"error": "malformed Content-Length"})
+            self._reply(400, {"error": "malformed Content-Length",
+                              "request_id": req_id}, id_hdr)
+            self._access_log(req_id, 0, 400, None)
             return
         body = self.rfile.read(length) if length > 0 else b""
+        # the clock starts AFTER the body drain: latency_ms and the
+        # parse/queue/compute split measure server-side work only — a
+        # slow client upload must not pollute the /metricz percentiles
+        # or fire slow_request alerts
+        t0 = time.monotonic()
         kind = {"/predict": "predict", "/predict_raw": "raw",
                 "/predict_leaf": "leaf"}.get(self.path.split("?")[0])
         if kind is None:
-            self._reply(404, {"error": f"unknown path {self.path}"})
+            self._reply(404, {"error": f"unknown path {self.path}",
+                              "request_id": req_id}, id_hdr)
+            self._access_log(req_id, 0, 404, None)
             return
-        t0 = time.monotonic()
         try:
             rows = _parse_rows(body, self.headers.get("Content-Type"))
             if rows.size == 0:
                 raise ValueError("no rows in request body")
         except Exception as e:  # malformed request: the CALLER's fault
             self.metrics.record_error()
-            self._reply(400, {"error": str(e)})
+            self._reply(400, {"error": str(e), "request_id": req_id},
+                        id_hdr)
+            self._access_log(req_id, 0, 400, None)
             return
+        t_parsed = time.monotonic()
+        fut = None
         try:
-            out = self.batcher.predict(rows, kind=kind, timeout=60.0)
+            fut = self.batcher.submit(rows, kind=kind)
+            out = fut.result(timeout=60.0)
         except Exception as e:  # dispatch fault/timeout: OUR fault — a
             self.metrics.record_error()  # 4xx would read as a caller
-            self._reply(500, {"error": str(e)})  # error and stop retries
+            self._reply(500, {"error": str(e),  # error and stop retries
+                              "request_id": req_id}, id_hdr)
+            self._access_log(req_id, rows.shape[0], 500, None)
             return
         latency = time.monotonic() - t0
+        # the per-request latency split (docs/Serving.md): parse is this
+        # handler thread, queue is enqueue->batch dispatch (time spent
+        # waiting for company), compute is the coalesced device call the
+        # request rode (batcher future timestamps)
+        timing = {"parse_ms": round((t_parsed - t0) * 1e3, 3),
+                  "total_ms": round(latency * 1e3, 3)}
+        if fut.t_dispatch is not None and fut.t_done is not None:
+            timing["queue_ms"] = round(
+                (fut.t_dispatch - fut.t_enqueue) * 1e3, 3)
+            timing["compute_ms"] = round(
+                (fut.t_done - fut.t_dispatch) * 1e3, 3)
         self.metrics.record_request(rows.shape[0], latency)
+        headers = dict(id_hdr)
+        headers["X-Timing-Ms"] = ";".join(
+            f"{k[:-3]}={v}" for k, v in sorted(timing.items()))
         self._reply(200, {"predictions": np.asarray(out).tolist(),
                           "rows": int(rows.shape[0]),
-                          "latency_ms": round(latency * 1e3, 3)})
+                          "latency_ms": round(latency * 1e3, 3),
+                          "request_id": req_id,
+                          "timing_ms": timing}, headers)
+        slow = self.slow_request_ms
+        if slow and latency * 1e3 >= slow:
+            Log.structured("Warning", "slow_request", request_id=req_id,
+                           path=self.path.split("?")[0],
+                           rows=int(rows.shape[0]),
+                           threshold_ms=slow, **timing)
+        self._access_log(req_id, rows.shape[0], 200, timing)
 
 
 def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
-                max_batch_rows=None):
+                max_batch_rows=None,
+                slow_request_ms=DEFAULT_SLOW_REQUEST_MS):
     """Wire predictor + batcher + metrics into a ThreadingHTTPServer
     (not yet serving — call serve_forever, or use it from tests)."""
     metrics = ServingMetrics()
@@ -163,7 +263,8 @@ def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
                            max_wait_ms=max_wait_ms, metrics=metrics)
     handler = type("BoundServingHandler", (ServingHandler,),
                    {"batcher": batcher, "metrics": metrics,
-                    "predictor": predictor})
+                    "predictor": predictor,
+                    "slow_request_ms": float(slow_request_ms or 0.0)})
     srv = ThreadingHTTPServer((host, port), handler)
     srv.batcher = batcher
     srv.metrics = metrics
@@ -185,6 +286,11 @@ def main(argv=None):
                          "pre-compiled row bucket")
     ap.add_argument("--max-wait-ms", type=float, default=2.0,
                     help="how long a lone request waits for company")
+    ap.add_argument("--slow-request-ms", type=float,
+                    default=DEFAULT_SLOW_REQUEST_MS,
+                    help="requests slower than this emit a structured "
+                         "slow-request log line (0 = off; mirrors the "
+                         "slow_request_ms config knob)")
     ap.add_argument("--num-iteration", type=int, default=-1,
                     help="serve only the first N iterations of the model")
     args = ap.parse_args(argv)
@@ -195,7 +301,8 @@ def main(argv=None):
         max_batch_rows=args.max_batch_rows)
     srv = make_server(predictor, host=args.host, port=args.port,
                       max_wait_ms=args.max_wait_ms,
-                      max_batch_rows=args.max_batch_rows)
+                      max_batch_rows=args.max_batch_rows,
+                      slow_request_ms=args.slow_request_ms)
     Log.info("serving %s on http://%s:%d (%d trees, load+warm %.2fs, "
              "%d compile-cache hits)", args.model, args.host, args.port,
              predictor.num_trees, time.time() - t0,

@@ -14,14 +14,27 @@ The training-side observability stack (docs/Observability.md):
   `journal.SCHEMA`, linted by `tools/check_journal.py`.
 - `trainz.start_trainz` — opt-in stdlib HTTP thread serving the live
   training state (`telemetry_port` knob).
+- `ledger.CompileLedger` / `ledger.sample_memory` — jit-lowering
+  ledger (shape-bucket labels, persistent-cache hit/miss) and device/
+  host memory watermarks.
+- `roofline.TABLE` — live per-kernel achieved bytes/s vs a measured
+  STREAM-style peak.
+- `prometheus.render` — the registry in Prometheus text exposition
+  (`?format=prometheus` on /metricz and /trainz).
+- `export.export_trace` — the journal (+ span-ring dump) as Chrome
+  trace-event JSON for Perfetto (`tools/export_trace.py`).
 
 Everything here is jax-free unless the jax-annotation passthrough is
-explicitly enabled, so the supervisor and CPU test harness can import
-it without touching the accelerator runtime.
+explicitly enabled (the compile ledger's `install()` touches jax's
+monitoring API only when jax is importable), so the supervisor and CPU
+test harness can import it without touching the accelerator runtime.
 """
 
-from . import journal, registry, trace, trainz  # noqa: F401
+from . import export, journal, ledger, prometheus  # noqa: F401
+from . import registry, roofline, trace, trainz  # noqa: F401
+from .export import build_trace, export_trace, validate_trace  # noqa: F401
 from .journal import RunJournal, merge_journals, read_journal  # noqa: F401
+from .ledger import LEDGER, CompileLedger, sample_memory  # noqa: F401
 from .registry import MetricsRegistry  # noqa: F401
 from .trace import SpanTracer  # noqa: F401
 from .trainz import start_trainz, stop_trainz  # noqa: F401

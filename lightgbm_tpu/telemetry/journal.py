@@ -80,6 +80,26 @@ SCHEMA = {
                              "new_rank": int, "source": str}},
     "run_end": {"required": {"iterations": int},
                 "optional": {"train_s": float, "source": str}},
+    # device-memory watermarks sampled at iteration/block boundaries
+    # (telemetry/ledger.py sample_memory; device_* absent on backends
+    # without allocator stats — this image's CPU jax returns None)
+    "memory": {"required": {"iteration": int},
+               "optional": {"device_bytes_in_use": int,
+                            "device_peak_bytes": int,
+                            "host_rss_bytes": int,
+                            "host_peak_rss_bytes": int}},
+    # one jit lowering (telemetry/ledger.py CompileLedger): label names
+    # the shape bucket ("fused_scan_10it", "serving_bucket_256"),
+    # seconds is backend-compile wall time (0.0 on a persistent-cache
+    # hit), cache_hit whether the persistent compile cache served it
+    "compile": {"required": {"label": str},
+                "optional": {"seconds": float, "cache_hit": bool,
+                             "count": int, "source": str}},
+    # dump of the tracer's recent-span ring at close (telemetry_trace
+    # knob): epoch_ts maps span start offsets to wall time, spans is
+    # the Span.as_dict() list the trace exporter turns into slices
+    "spans": {"required": {"epoch_ts": float, "spans": list},
+              "optional": {"source": str}},
     "note": {"required": {}, "optional": {"msg": str, "source": str}},
 }
 

@@ -1,0 +1,115 @@
+"""Prometheus text exposition of the metrics registry.
+
+`?format=prometheus` on /metricz (serving/server.py) and /trainz /
+/metricz (telemetry/trainz.py) renders the SAME single registry that
+backs the JSON views in the text exposition format (version 0.0.4), so
+a standard scrape job works against both the training and serving
+processes with zero extra dependencies:
+
+    scrape_configs:
+      - job_name: lightgbm_tpu
+        metrics_path: /metricz
+        params: {format: [prometheus]}
+
+Counters render as `counter`, gauges as `gauge`, registry histograms
+as `summary` (quantile series from the ring's nearest-rank
+percentiles, plus `_sum`/`_count` over the process lifetime). Names
+are prefixed `lightgbm_tpu_` and sanitized to the exposition charset;
+non-numeric extra values are skipped rather than corrupting the page.
+"""
+
+import re
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name, prefix="lightgbm_tpu"):
+    """Metric name -> exposition-legal name (`[a-zA-Z_:][a-zA-Z0-9_:]*`),
+    prefixed. Every illegal char becomes `_`."""
+    name = _BAD_CHARS.sub("_", str(name))
+    if not name or not _NAME_OK.match(name):
+        name = "_" + name
+    return f"{prefix}_{name}" if prefix else name
+
+
+def _fmt(v):
+    """Exposition float formatting (no exponent-less NaN/Inf issues:
+    Prometheus accepts NaN/+Inf/-Inf literals, but the registry never
+    stores them — JSON-sanitized upstream)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def render(snapshot, prefix="lightgbm_tpu", extra_gauges=None):
+    """Registry snapshot (MetricsRegistry.snapshot(): counters/gauges/
+    histograms) -> exposition text. `extra_gauges` is a flat
+    {name: number} dict appended as gauges (serving warmup stats,
+    queue depth, roofline numbers...)."""
+    lines = []
+
+    def emit(name, kind, samples):
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(samples)
+
+    for name, value in sorted((snapshot.get("counters") or {}).items()):
+        if not isinstance(value, (int, float)):
+            continue
+        n = sanitize_name(name, prefix)
+        emit(n, "counter", [f"{n} {_fmt(value)}"])
+    for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        if not isinstance(value, (int, float)):
+            continue
+        n = sanitize_name(name, prefix)
+        emit(n, "gauge", [f"{n} {_fmt(value)}"])
+    for name, summ in sorted((snapshot.get("histograms") or {}).items()):
+        if not isinstance(summ, dict):
+            continue
+        n = sanitize_name(name, prefix)
+        samples = []
+        for pct, q in ((50, "0.5"), (95, "0.95"), (99, "0.99")):
+            v = summ.get(f"p{pct}")
+            if isinstance(v, (int, float)):
+                samples.append(f'{n}{{quantile="{q}"}} {_fmt(v)}')
+        if isinstance(summ.get("total"), (int, float)):
+            samples.append(f"{n}_sum {_fmt(summ['total'])}")
+        if isinstance(summ.get("count"), (int, float)):
+            samples.append(f"{n}_count {_fmt(summ['count'])}")
+        if samples:
+            emit(n, "summary", samples)
+    for name, value in sorted((extra_gauges or {}).items()):
+        if not isinstance(value, (int, float)):
+            continue
+        n = sanitize_name(name, prefix)
+        emit(n, "gauge", [f"{n} {_fmt(value)}"])
+    return "\n".join(lines) + "\n"
+
+
+def parse(text):
+    """Minimal exposition parser: {name: value} for plain samples,
+    {name{labels}: value} kept verbatim for labeled ones. Raises
+    ValueError on a malformed line — the round-trip check tests and
+    `make verify-obs` rely on."""
+    out = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(" ", 1)
+        if len(parts) != 2:
+            raise ValueError(f"line {lineno}: not 'name value': {line!r}")
+        name, value = parts
+        base = name.split("{", 1)[0]
+        if not _NAME_OK.match(base):
+            raise ValueError(f"line {lineno}: bad metric name {base!r}")
+        if name in out:
+            # the exposition format forbids duplicate series — a real
+            # Prometheus server rejects the whole scrape on one
+            raise ValueError(f"line {lineno}: duplicate sample {name!r}")
+        out[name] = float(value)   # ValueError on a bad float
+    return out

@@ -1,0 +1,130 @@
+"""Roofline attribution: live per-kernel bandwidth vs a measured peak.
+
+GPU boosting systems treat per-kernel achieved bandwidth as the
+primary tuning instrument (arXiv:1706.08359 §5, arXiv:2005.09148); the
+bench already computes a one-shot `hist_bytes_per_s` microprobe. This
+module makes the number LIVE: the histogram host-callback kernels
+(ops/histogram.py bincount mode — the CPU default and where the
+engine's 9.7x lives) time themselves and record (seconds, bytes
+streamed, rows scanned) per call into a process-wide table; /trainz
+serves per-kernel achieved bytes/s and rows/s against a once-measured
+STREAM-style copy peak, and `roofline_warn_fraction > 0` flags kernels
+running below that fraction of peak at end of run.
+
+Scope is honest by construction: only kernels whose execution the host
+can actually observe record live (the bincount callbacks run ON the
+host; fully in-graph kernels — Pallas, einsum, segment — are invisible
+to host timers inside one XLA program and stay covered by the bench's
+single-op microprobes, tools/microbench.py). The table is process-wide
+(the callbacks have no booster handle), same singleton shape as
+journal.current().
+
+The peak is measured lazily once per process (a ~64 MB numpy copy
+triad — memcpy streams 2x the buffer, the classic STREAM COPY
+accounting) and can be pinned via LIGHTGBM_TPU_STREAM_PEAK (bytes/s)
+when a machine's number is already known (tools/microbench.py prints
+it as `stream_host`).
+"""
+
+import os
+import threading
+import time
+
+PEAK_ENV = "LIGHTGBM_TPU_STREAM_PEAK"
+
+_PEAK_LOCK = threading.Lock()
+_PEAK = None
+
+
+def measure_stream_peak(size_mb=64, reps=3):
+    """STREAM-style COPY bandwidth of this host (bytes/s): best of
+    `reps` timed copies of a `size_mb` buffer, counting read+write
+    bytes. ~50 ms once per process at the default size."""
+    import numpy as np
+    n = int(size_mb) * (1 << 20) // 8
+    src = np.ones(n, dtype=np.float64)
+    dst = np.empty_like(src)
+    best = 0.0
+    for _ in range(int(reps)):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        dt = time.perf_counter() - t0
+        best = max(best, 2.0 * src.nbytes / max(dt, 1e-9))
+    return best
+
+
+def stream_peak_bytes_per_s():
+    """The cached process-wide peak (env override wins; measured once
+    otherwise)."""
+    global _PEAK
+    with _PEAK_LOCK:
+        if _PEAK is None:
+            env = os.environ.get(PEAK_ENV)
+            if env:
+                try:
+                    _PEAK = float(env)
+                except ValueError:
+                    _PEAK = measure_stream_peak()
+            else:
+                _PEAK = measure_stream_peak()
+        return _PEAK
+
+
+class RooflineTable:
+    """Per-kernel (calls, seconds, bytes, rows) accumulator with
+    peak-relative snapshots."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels = {}
+
+    def record(self, kernel, seconds, nbytes, rows):
+        """One kernel execution: `nbytes` streamed, `rows` scanned, in
+        `seconds` of host wall time. O(1), one short lock hold — cheap
+        enough for once-per-histogram-build call sites."""
+        with self._lock:
+            k = self._kernels.get(kernel)
+            if k is None:
+                k = self._kernels[kernel] = {"calls": 0, "seconds": 0.0,
+                                             "bytes": 0, "rows": 0}
+            k["calls"] += 1
+            k["seconds"] += float(seconds)
+            k["bytes"] += int(nbytes)
+            k["rows"] += int(rows)
+
+    def reset(self):
+        with self._lock:
+            self._kernels.clear()
+
+    def snapshot(self, warn_fraction=0.0, peak=None):
+        """JSON-ready per-kernel roofline view. `peak` defaults to the
+        lazily-measured host STREAM peak; kernels whose achieved
+        bytes/s fall below `warn_fraction * peak` carry
+        `below_peak_fraction: true` (the end-of-run warning's input,
+        models/gbdt.py)."""
+        with self._lock:
+            kernels = {name: dict(k) for name, k in self._kernels.items()}
+        if not kernels:
+            return {"peak_bytes_per_s": None, "kernels": {}}
+        if peak is None:
+            peak = stream_peak_bytes_per_s()
+        out = {}
+        for name, k in kernels.items():
+            secs = k["seconds"]
+            entry = {"calls": k["calls"], "seconds": round(secs, 6),
+                     "bytes": k["bytes"], "rows": k["rows"]}
+            if secs > 0:
+                bps = k["bytes"] / secs
+                entry["bytes_per_s"] = round(bps, 1)
+                entry["rows_per_s"] = round(k["rows"] / secs, 1)
+                if peak:
+                    entry["pct_of_peak"] = round(100.0 * bps / peak, 2)
+                    if warn_fraction > 0:
+                        entry["below_peak_fraction"] = \
+                            bool(bps < warn_fraction * peak)
+            out[name] = entry
+        return {"peak_bytes_per_s": round(peak, 1) if peak else None,
+                "kernels": out}
+
+
+TABLE = RooflineTable()

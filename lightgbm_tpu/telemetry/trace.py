@@ -36,22 +36,27 @@ RECENT_SPANS = 256
 
 class Span:
     """One completed (or open) span. `path` includes parents:
-    "train/build"."""
+    "train/build". `tid` is the recording thread's ident, so concurrent
+    threads (batcher worker, heartbeat monitor, the training loop) land
+    on separate tracks in an exported trace (telemetry/export.py)."""
 
-    __slots__ = ("name", "path", "start", "duration", "tags")
+    __slots__ = ("name", "path", "start", "duration", "tags", "tid")
 
-    def __init__(self, name, path, start, duration=None, tags=None):
+    def __init__(self, name, path, start, duration=None, tags=None,
+                 tid=0):
         self.name = name
         self.path = path
         self.start = start
         self.duration = duration
         self.tags = tags or {}
+        self.tid = tid
 
     def as_dict(self):
         return {"name": self.name, "path": self.path,
                 "start_s": round(self.start, 6),
                 "duration_s": (round(self.duration, 6)
                                if self.duration is not None else None),
+                "tid": self.tid,
                 **({"tags": self.tags} if self.tags else {})}
 
 
@@ -112,6 +117,10 @@ class SpanTracer:
         self._recent = deque(maxlen=RECENT_SPANS)
         self._local = threading.local()
         self._epoch = time.perf_counter()
+        # wall-clock time of the perf_counter epoch: span start offsets
+        # + epoch_wall = journal-comparable epoch seconds, the mapping
+        # the trace exporter uses to line spans up with journal records
+        self.epoch_wall = time.time()
 
     # ------------------------------------------------------------- spans
     def _stack(self):
@@ -140,14 +149,23 @@ class SpanTracer:
             self.acc[name] += elapsed
             self.cnt[name] += 1
             self._recent.append(Span(name, path, t0 - self._epoch,
-                                     elapsed, tags))
+                                     elapsed, tags,
+                                     tid=threading.get_ident()))
 
     def add(self, name, seconds):
         """Accumulate an externally-timed phase (e.g. the bench's
-        compile window)."""
+        compile window). Also lands a synthetic span in the recent ring
+        — ending NOW, `seconds` long — so externally-timed phases show
+        up on /trainz and in exported traces instead of vanishing from
+        every per-span view."""
+        seconds = float(seconds)
         with self._lock:
-            self.acc[name] += float(seconds)
+            self.acc[name] += seconds
             self.cnt[name] += 1
+            start = time.perf_counter() - seconds - self._epoch
+            self._recent.append(Span(name, name, start, seconds,
+                                     {"synthetic": True},
+                                     tid=threading.get_ident()))
 
     # ----------------------------------------------------------- readers
     def reset(self):
@@ -157,6 +175,7 @@ class SpanTracer:
             self._last.clear()
             self._recent.clear()
             self._epoch = time.perf_counter()
+            self.epoch_wall = time.time()
 
     def snapshot(self):
         """{phase: total_seconds}, machine-readable (bench JSON)."""
@@ -178,9 +197,12 @@ class SpanTracer:
         return out
 
     def recent(self, n=32):
-        """Last `n` completed spans, oldest first (`/trainz`)."""
+        """Last `n` completed spans, oldest first (`/trainz`); `n=None`
+        dumps the whole ring (the journal `spans` record at close)."""
         with self._lock:
-            spans = list(self._recent)[-int(n):]
+            spans = list(self._recent)
+        if n is not None:
+            spans = spans[-int(n):]
         return [s.as_dict() for s in spans]
 
     def report(self):

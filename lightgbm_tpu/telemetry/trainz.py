@@ -12,11 +12,21 @@ training run as one JSON document:
 - `heartbeats`: per-rank seconds since each peer's beat last changed
   (multi-host runs with the heartbeat service up; parallel/heartbeat.py)
 - `journal_tail`: the last records of this rank's run journal
+- `memory`: device/host memory watermarks (telemetry/ledger.py)
+- `compile`: the jit-lowering ledger (counts, seconds, cache hits)
+- `roofline`: live per-kernel achieved bandwidth vs the measured
+  STREAM peak (telemetry/roofline.py)
 
-Also serves /healthz (liveness). Enabled by `telemetry_port > 0`
-(docs/Parameters.md); `start_trainz(..., port=0)` binds an ephemeral
-port (tests). The handler thread only READS shared state — it can
-never stall the training loop.
+Also serves /healthz (liveness) and /metricz (the registry alone —
+the training-side scrape target mirroring the serving layer's).
+`?format=prometheus` on /trainz and /metricz renders the registry in
+text exposition format (telemetry/prometheus.py) so standard scrapers
+work without a sidecar.
+
+Enabled by `telemetry_port > 0` (docs/Parameters.md);
+`start_trainz(..., port=0)` binds an ephemeral port (tests). The
+handler thread only READS shared state — it can never stall the
+training loop.
 
 Sources are held weakly-ish via zero-arg callables so a finished
 booster is not kept alive by a lingering server thread.
@@ -25,9 +35,11 @@ booster is not kept alive by a lingering server thread.
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
 
 from ..utils.log import Log
 from . import journal as journal_mod
+from . import prometheus
 
 
 class TrainzHandler(BaseHTTPRequestHandler):
@@ -45,13 +57,79 @@ class TrainzHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _reply_text(self, code, text, content_type):
+        data = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _source(self, name):
+        fn = (self.sources or {}).get(name)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:   # a dead source must not 500 the page
+            return None
+
+    def _prometheus(self):
+        """The single registry (plus the scalar extras a scraper
+        wants: iteration, compile totals, memory watermarks, per-
+        kernel roofline bandwidth) in text exposition format."""
+        snapshot = self._source("metrics") or {}
+        extra = {}
+        it = self._source("iteration")
+        if it is not None:
+            extra["iteration"] = it
+        comp = self._source("compile")
+        if isinstance(comp, dict):
+            extra.update({f"compile_{k}": v for k, v in comp.items()
+                          if isinstance(v, (int, float))})
+        mem = self._source("memory")
+        if isinstance(mem, dict):
+            extra.update(mem)
+        roof = self._source("roofline")
+        if isinstance(roof, dict):
+            if roof.get("peak_bytes_per_s"):
+                extra["stream_peak_bytes_per_s"] = roof["peak_bytes_per_s"]
+            for kname, k in (roof.get("kernels") or {}).items():
+                for field in ("bytes_per_s", "rows_per_s", "calls"):
+                    if isinstance(k.get(field), (int, float)):
+                        extra[f"roofline_{kname}_{field}"] = k[field]
+        # GBDT mirrors the memory sample into registry gauges — drop
+        # any extra whose name the registry already owns: a duplicate
+        # metric name makes a real Prometheus server reject the WHOLE
+        # scrape (the exposition format forbids it)
+        owned = (set(snapshot.get("counters") or ())
+                 | set(snapshot.get("gauges") or ())
+                 | set(snapshot.get("histograms") or ()))
+        extra = {k: v for k, v in extra.items() if k not in owned}
+        return prometheus.render(snapshot, extra_gauges=extra)
+
     def do_GET(self):
-        path = self.path.split("?")[0]
+        parts = urlsplit(self.path)
+        path = parts.path
+        fmt = (parse_qs(parts.query).get("format") or [""])[0]
         if path.startswith("/healthz"):
             self._reply(200, {"status": "ok"})
             return
-        if not path.startswith("/trainz"):
+        if not (path.startswith("/trainz") or path.startswith("/metricz")):
             self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        if fmt == "prometheus":
+            self._reply_text(200, self._prometheus(),
+                             prometheus.CONTENT_TYPE)
+            return
+        if path.startswith("/metricz"):
+            # the registry alone: the training-side scrape document
+            out = {"metrics": self._source("metrics")}
+            for name in ("iteration", "memory", "compile"):
+                val = self._source(name)
+                if val is not None:
+                    out[name] = val
+            self._reply(200, out)
             return
         out = {}
         for name, fn in (self.sources or {}).items():
@@ -63,10 +141,11 @@ class TrainzHandler(BaseHTTPRequestHandler):
 
 
 def build_sources(iteration_fn=None, tracer=None, registry=None,
-                  journal=None, tail_n=20):
+                  journal=None, tail_n=20, roofline_warn_fraction=0.0):
     """Assemble the /trainz source map from whatever exists. The
     heartbeat service is resolved lazily per request (it may start
-    after the endpoint does)."""
+    after the endpoint does); memory/compile/roofline read the
+    process-wide telemetry singletons."""
     sources = {}
     if iteration_fn is not None:
         sources["iteration"] = lambda: int(iteration_fn())
@@ -90,6 +169,23 @@ def build_sources(iteration_fn=None, tracer=None, registry=None,
     if journal is not None:
         sources["journal_tail"] = lambda: journal_mod.tail(journal.path,
                                                            tail_n)
+
+    def memory():
+        from . import ledger
+        return ledger.sample_memory()
+
+    def compile_ledger():
+        from . import ledger
+        return ledger.LEDGER.snapshot()
+
+    def roofline_view():
+        from . import roofline
+        return roofline.TABLE.snapshot(
+            warn_fraction=roofline_warn_fraction)
+
+    sources["memory"] = memory
+    sources["compile"] = compile_ledger
+    sources["roofline"] = roofline_view
     return sources
 
 

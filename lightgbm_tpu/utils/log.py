@@ -83,6 +83,30 @@ class Log:
         msg = (fmt % args) if args else str(fmt)
         raise LightGBMError(msg)
 
+    _LEVELS = {"Debug": 2, "Info": 1, "Warning": 0}
+
+    @classmethod
+    def structured(cls, level, event, **fields):
+        """One machine-attributable record (serving access logs,
+        slow-request lines). In LIGHTGBM_TPU_LOG_JSON mode the fields
+        merge into the line's JSON object next to ts/level/rank; in
+        text mode they render as `event k=v ...`. `level` is "Debug" /
+        "Info" / "Warning" and gates like the plain methods."""
+        if cls._level < cls._LEVELS.get(level, 1):
+            return
+        if os.environ.get("LIGHTGBM_TPU_LOG_JSON", "") not in ("", "0"):
+            rec = {"ts": datetime.datetime.now().isoformat(
+                       timespec="milliseconds"),
+                   "level": level, "event": str(event)}
+            if cls._rank is not None:
+                rec["rank"] = cls._rank
+            rec.update(fields)
+            sys.stdout.write(json.dumps(rec, default=str) + "\n")
+            sys.stdout.flush()
+            return
+        kv = " ".join(f"{k}={v}" for k, v in fields.items())
+        cls._write(level, "%s %s", (event, kv))
+
     @classmethod
     def _write(cls, level_str, fmt, args):
         msg = (fmt % args) if args else str(fmt)

@@ -558,3 +558,198 @@ def test_serve_cli_end_to_end(tmp_path, binary_model):
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait(timeout=10)
+
+
+# ---------------------------------------- request-level traces (PR 8)
+
+def test_request_id_and_timing_breakdown(binary_model):
+    """Every POST echoes a request id (caller's X-Request-Id or a
+    generated one) and a parse/queue/compute latency split in both the
+    JSON body and response headers."""
+    gbdt, X = binary_model
+    cp = CompiledPredictor.from_booster(gbdt, max_batch_rows=32)
+    srv = make_server(cp, port=0, max_wait_ms=1.0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"rows": X[:3].tolist()}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "client-id-7"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers["X-Request-Id"] == "client-id-7"
+            timing_hdr = r.headers["X-Timing-Ms"]
+            body = json.loads(r.read())
+        assert body["request_id"] == "client-id-7"
+        timing = body["timing_ms"]
+        for k in ("parse_ms", "queue_ms", "compute_ms", "total_ms"):
+            assert timing[k] >= 0.0, timing
+        # the split is consistent: parts cannot exceed the total
+        assert (timing["parse_ms"] + timing["queue_ms"]
+                + timing["compute_ms"]) <= timing["total_ms"] + 0.5
+        assert body["latency_ms"] == timing["total_ms"]
+        # header mirrors the body split
+        assert "queue=" in timing_hdr and "compute=" in timing_hdr
+
+        # no header -> a generated id, still echoed both places
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict_raw",
+            data=json.dumps({"row": X[0].tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=30) as r:
+            gen = r.headers["X-Request-Id"]
+            body2 = json.loads(r.read())
+        assert gen and body2["request_id"] == gen
+        assert gen != "client-id-7"
+
+        # hostile ids are sanitized (header-injection chars dropped)
+        req3 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"rows": X[:1].tolist()}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "a b<c>d" + "x" * 200})
+        with urllib.request.urlopen(req3, timeout=30) as r:
+            echoed = r.headers["X-Request-Id"]
+            r.read()
+        assert "<" not in echoed and " " not in echoed
+        assert len(echoed) <= 64
+
+        # errors carry the id too (the greppable failure story)
+        req4 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict", data=b"{}",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "err-1"})
+        try:
+            urllib.request.urlopen(req4, timeout=30)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert e.headers["X-Request-Id"] == "err-1"
+            assert json.loads(e.read())["request_id"] == "err-1"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv.batcher.close()
+
+
+def test_access_and_slow_request_logs(binary_model, capsys, monkeypatch):
+    """One structured access-log record per request honoring
+    LIGHTGBM_TPU_LOG_JSON, and a slow-request record above the
+    threshold with the same latency split."""
+    from lightgbm_tpu.utils.log import Log
+    gbdt, X = binary_model
+    monkeypatch.setenv("LIGHTGBM_TPU_LOG_JSON", "1")
+    # the fixture trained with verbose=-1 (fatal-only): raise to Info
+    # so the access records (and the Warning slow line) are emitted
+    monkeypatch.setattr(Log, "_level", 1)
+    cp = CompiledPredictor.from_booster(gbdt, max_batch_rows=32)
+    # threshold 0.0001 ms: every request is "slow" deterministically
+    srv = make_server(cp, port=0, max_wait_ms=1.0,
+                      slow_request_ms=0.0001)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        capsys.readouterr()   # drop warmup/server noise
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"rows": X[:2].tolist()}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "slowone"})
+        urllib.request.urlopen(req, timeout=30).read()
+        time.sleep(0.05)   # handler thread flushes its log lines
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.splitlines()
+                 if ln.startswith("{")]
+        access = [r for r in lines if r.get("event") == "access"]
+        assert len(access) == 1, lines
+        rec = access[0]
+        assert rec["request_id"] == "slowone"
+        assert rec["path"] == "/predict" and rec["rows"] == 2
+        assert rec["status"] == 200
+        for k in ("parse_ms", "queue_ms", "compute_ms", "total_ms"):
+            assert k in rec
+        slow = [r for r in lines if r.get("event") == "slow_request"]
+        assert len(slow) == 1
+        assert slow[0]["request_id"] == "slowone"
+        assert slow[0]["level"] == "Warning"
+        assert slow[0]["total_ms"] >= 0.0001
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv.batcher.close()
+
+
+def test_metricz_prometheus_under_live_traffic(binary_model):
+    """/metricz?format=prometheus parses while the batcher actively
+    serves concurrent clients — no torn reads, counters land."""
+    from lightgbm_tpu.telemetry import prometheus
+    gbdt, X = binary_model
+    cp = CompiledPredictor.from_booster(gbdt, max_batch_rows=32)
+    srv = make_server(cp, port=0, max_wait_ms=2.0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    errors, stop = [], threading.Event()
+
+    def client():
+        body = json.dumps({"rows": X[:4].tolist()}).encode()
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/predict", data=body,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=30).read()
+            except Exception as e:   # noqa: BLE001
+                errors.append(repr(e))
+                return
+
+    workers = [threading.Thread(target=client) for _ in range(3)]
+    try:
+        for w in workers:
+            w.start()
+        parsed_pages = 0
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and parsed_pages < 20:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metricz?format=prometheus",
+                    timeout=30) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                page = prometheus.parse(r.read().decode())
+            assert "lightgbm_tpu_request_count" in page
+            assert "lightgbm_tpu_queue_depth" in page
+            parsed_pages += 1
+        stop.set()
+        for w in workers:
+            w.join(timeout=30)
+        assert not errors, errors
+        assert parsed_pages >= 20
+        final = prometheus.parse(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metricz?format=prometheus",
+            timeout=30).read().decode())
+        assert final["lightgbm_tpu_request_count"] > 0
+        assert final["lightgbm_tpu_rows_served"] > 0
+        assert 'lightgbm_tpu_latency_ms{quantile="0.5"}' in final
+        # JSON view still intact next to the exposition view
+        snap = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metricz", timeout=30).read())
+        assert snap["request_count"] == int(
+            final["lightgbm_tpu_request_count"])
+    finally:
+        stop.set()
+        srv.shutdown()
+        srv.server_close()
+        srv.batcher.close()
+
+
+def test_serving_warmup_lands_in_compile_ledger(binary_model):
+    """The AOT warmup's lowerings are attributed to their row bucket in
+    the process-wide compile ledger (`serving_bucket_N` labels)."""
+    from lightgbm_tpu.telemetry.ledger import LEDGER
+    gbdt, _ = binary_model
+    CompiledPredictor.from_booster(gbdt, max_batch_rows=16)
+    snap = LEDGER.snapshot(recent_n=256)
+    # in-process jit caching means THIS warmup may add no new entries
+    # when an earlier test already compiled the same (kernel, bucket)
+    # pairs — but some warmup in this process must have been attributed
+    labels = {e["label"] for e in snap["recent"]}
+    assert any(lbl.startswith("serving_bucket_") for lbl in labels), labels

@@ -472,3 +472,398 @@ def test_check_journal_cli_flags_violations(tmp_path):
     assert fail.returncode == 1
     assert "unknown event" in fail.stderr
     assert "torn/garbled" in fail.stderr
+
+
+# ----------------------------------- performance introspection (PR 8)
+#
+# The introspection layer on top of the PR-5 instrument: synthetic
+# spans for externally-timed phases, the compile ledger, the live
+# roofline table, Prometheus exposition, /metricz, and the Chrome
+# trace-event exporter (docs/Observability.md).
+
+def test_add_records_synthetic_span_with_tid():
+    """SpanTracer.add() used to bump acc/cnt only — externally-timed
+    phases (the bench compile window) vanished from /trainz and every
+    exported trace. It must land a synthetic span stamped with the
+    recording thread's id."""
+    t = SpanTracer()
+    t.add("compile", 1.5)
+    spans = t.recent()
+    assert len(spans) == 1
+    assert spans[0]["name"] == "compile"
+    assert spans[0]["duration_s"] == pytest.approx(1.5)
+    assert spans[0]["tags"] == {"synthetic": True}
+    assert spans[0]["tid"] == threading.get_ident()
+    assert t.acc["compile"] == pytest.approx(1.5) and t.cnt["compile"] == 1
+    # a span recorded on another thread carries ITS tid (separate
+    # export track); n=None dumps the whole ring (the journal's
+    # `spans` record at close)
+    th = threading.Thread(target=lambda: t.add("other", 0.1))
+    th.start()
+    th.join()
+    dump = t.recent(n=None)
+    assert len(dump) == 2
+    assert len({s["tid"] for s in dump}) == 2
+
+
+def test_compile_ledger_attribution_and_drain():
+    from lightgbm_tpu.telemetry.ledger import (_CACHE_HIT_EVENT,
+                                               _CACHE_MISS_EVENT,
+                                               _COMPILE_EVENT,
+                                               CompileLedger)
+    led = CompileLedger()
+    with led.label("fused_scan_10it"):
+        led._on_duration(_COMPILE_EVENT, 1.25)
+        led._on_event(_CACHE_MISS_EVENT)
+    led._on_event(_CACHE_HIT_EVENT)       # hit = 0-cost ledger entry
+    led._on_duration("/jax/unrelated/event", 9.0)   # ignored
+    snap = led.snapshot()
+    assert snap["compiles"] == 1
+    assert snap["total_s"] == pytest.approx(1.25)
+    assert snap["cache_hits"] == 1 and snap["cache_misses"] == 1
+    assert [e["label"] for e in snap["recent"]] == ["fused_scan_10it", ""]
+    hit = snap["recent"][-1]
+    assert hit["cache_hit"] is True and hit["seconds"] == 0.0
+    # label stack unwinds: a compile after the context is unattributed
+    assert led.current_label() == ""
+    # drain() hands each entry to the journal writer exactly once;
+    # totals survive the drain (the /trainz view is cumulative)
+    assert len(led.drain()) == 2
+    assert led.drain() == []
+    assert led.snapshot()["compiles"] == 1
+    assert led.snapshot(recent_n=0)["recent"] == []
+
+
+def test_ledger_memory_sample_has_host_watermarks():
+    from lightgbm_tpu.telemetry.ledger import sample_memory
+    mem = sample_memory()
+    # this image's CPU jax publishes no device allocator stats, but the
+    # host RSS pair from /proc + getrusage must always ride along
+    assert mem["host_rss_bytes"] > 0
+    assert mem["host_peak_rss_bytes"] >= 0
+
+
+def test_roofline_table_flags_below_peak():
+    from lightgbm_tpu.telemetry.roofline import RooflineTable
+    tab = RooflineTable()
+    tab.record("bincount_masked", 1.0, 10e9, 1000)
+    tab.record("bincount_masked", 1.0, 10e9, 1000)
+    tab.record("bincount_compacted", 1.0, 1e9, 500)
+    snap = tab.snapshot(warn_fraction=0.5, peak=20e9)
+    assert snap["peak_bytes_per_s"] == pytest.approx(20e9)
+    m = snap["kernels"]["bincount_masked"]
+    assert m["calls"] == 2
+    assert m["bytes_per_s"] == pytest.approx(10e9)
+    assert m["rows_per_s"] == pytest.approx(1000.0)
+    assert m["pct_of_peak"] == pytest.approx(50.0)
+    assert m["below_peak_fraction"] is False   # exactly at the line
+    c = snap["kernels"]["bincount_compacted"]
+    assert c["below_peak_fraction"] is True
+    tab.reset()
+    assert tab.snapshot()["kernels"] == {}
+
+
+def test_roofline_live_records_from_training(tmp_path):
+    """The bincount host-callback kernels (the CPU default engine)
+    record (seconds, bytes, rows) live into the process-wide table."""
+    from lightgbm_tpu.telemetry import roofline
+    roofline.TABLE.reset()
+    try:
+        # force the compacted engine: its bincount callbacks are the
+        # host-observable kernels (auto would skip compaction — and
+        # with it the callback path — on a single-chunk dataset)
+        _train(tmp_path, "roofline", n_rounds=3, hist_compaction="true")
+        snap = roofline.TABLE.snapshot(peak=1e9)   # pinned: no measure
+        kernels = snap["kernels"]
+        assert any(name.startswith("bincount") for name in kernels)
+        for k in kernels.values():
+            assert k["calls"] > 0 and k["bytes"] > 0 and k["rows"] > 0
+    finally:
+        roofline.TABLE.reset()
+
+
+def test_stream_peak_env_override(monkeypatch):
+    from lightgbm_tpu.telemetry import roofline
+    monkeypatch.setattr(roofline, "_PEAK", None)
+    monkeypatch.setenv(roofline.PEAK_ENV, "123456789.0")
+    assert roofline.stream_peak_bytes_per_s() == pytest.approx(123456789.0)
+
+
+def test_prometheus_render_parse_roundtrip():
+    from lightgbm_tpu.telemetry import prometheus
+    reg = MetricsRegistry()
+    reg.inc("tree_build_dispatches", 7)
+    reg.set("device_bytes_in_use", 12345)
+    h = reg.histogram("latency_ms")
+    for v in range(1, 101):
+        h.observe(float(v))
+    text = prometheus.render(reg.snapshot(),
+                             extra_gauges={"roofline hist/bytes": 3.5,
+                                           "iteration": 9,
+                                           "not a number": "skipped"})
+    parsed = prometheus.parse(text)   # raises on malformed exposition
+    assert parsed["lightgbm_tpu_tree_build_dispatches"] == 7
+    assert parsed["lightgbm_tpu_device_bytes_in_use"] == 12345
+    assert parsed['lightgbm_tpu_latency_ms{quantile="0.5"}'] in (50.0, 51.0)
+    assert parsed["lightgbm_tpu_latency_ms_count"] == 100
+    assert parsed["lightgbm_tpu_latency_ms_sum"] == pytest.approx(5050.0)
+    # illegal chars sanitize instead of corrupting the page; the
+    # non-numeric extra is skipped entirely
+    assert parsed["lightgbm_tpu_roofline_hist_bytes"] == 3.5
+    assert parsed["lightgbm_tpu_iteration"] == 9
+    assert not any("not" in k for k in parsed)
+    assert "# TYPE lightgbm_tpu_tree_build_dispatches counter" in text
+    assert "# TYPE lightgbm_tpu_latency_ms summary" in text
+
+
+def test_prometheus_parse_rejects_malformed():
+    from lightgbm_tpu.telemetry import prometheus
+    with pytest.raises(ValueError):
+        prometheus.parse("lightgbm_tpu_x 1 2 extra junk words\n")
+    with pytest.raises(ValueError):
+        prometheus.parse("9bad_name 1\n")
+    with pytest.raises(ValueError):
+        prometheus.parse("lightgbm_tpu_x notafloat\n")
+
+
+def test_trainz_metricz_and_prometheus_endpoints(tmp_path):
+    from lightgbm_tpu.telemetry import prometheus
+    tracer = SpanTracer()
+    with tracer.phase("build"):
+        pass
+    reg = MetricsRegistry()
+    reg.inc("tree_build_dispatches", 4)
+    j = RunJournal(str(tmp_path), rank=0)
+    j.iteration(3, phases={"build": 0.1})
+    srv = start_trainz(trainz.build_sources(
+        iteration_fn=lambda: 3, tracer=tracer, registry=reg, journal=j),
+        port=0)
+    try:
+        port = srv.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+                return r.headers.get("Content-Type"), r.read()
+
+        # /metricz JSON: the registry + introspection scalars only
+        _, raw = get("/metricz")
+        out = json.loads(raw)
+        assert out["metrics"]["counters"]["tree_build_dispatches"] == 4
+        assert out["iteration"] == 3
+        assert out["memory"]["host_rss_bytes"] > 0
+        assert "compiles" in out["compile"]
+        # /trainz carries the introspection sources too
+        _, raw = get("/trainz")
+        full = json.loads(raw)
+        for key in ("memory", "compile", "roofline"):
+            assert key in full
+        # ?format=prometheus on BOTH paths: parseable text exposition
+        for path in ("/metricz?format=prometheus",
+                     "/trainz?format=prometheus"):
+            ctype, raw = get(path)
+            assert ctype.startswith("text/plain")
+            parsed = prometheus.parse(raw.decode())
+            assert parsed["lightgbm_tpu_tree_build_dispatches"] == 4
+            assert parsed["lightgbm_tpu_iteration"] == 3
+            assert parsed["lightgbm_tpu_host_rss_bytes"] > 0
+    finally:
+        stop_trainz(srv)
+        j.close()
+
+
+def test_concurrent_scrape_during_training(tmp_path):
+    """/trainz and /metricz snapshots taken WHILE a Booster trains:
+    every scrape returns consistent JSON / parseable exposition — no
+    torn reads, no 500s (the satellite's acceptance)."""
+    from lightgbm_tpu.telemetry import prometheus
+    rng = np.random.RandomState(11)
+    x = rng.rand(400, 5)
+    y = (x[:, 0] + x[:, 1] > 1).astype(float)
+    holder, errors, scrapes = {}, [], []
+    stop = threading.Event()
+
+    def scraper():
+        port = holder["port"]
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/trainz",
+                        timeout=30) as r:
+                    out = json.loads(r.read())
+                    assert "phases" in out
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metricz"
+                        "?format=prometheus", timeout=30) as r:
+                    prometheus.parse(r.read().decode())
+                scrapes.append(1)
+            except Exception as e:   # noqa: BLE001 - recorded for assert
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=scraper) for _ in range(2)]
+
+    def cb(env):
+        g = env.model.gbdt
+        if "port" not in holder:
+            srv = start_trainz(trainz.build_sources(
+                iteration_fn=lambda: g.iter, tracer=g.tracer,
+                registry=g.metrics, journal=g.journal), port=0)
+            holder["srv"], holder["port"] = srv, srv.server_address[1]
+            for t in threads:
+                t.start()
+        time.sleep(0.005)   # guarantee scrapes overlap live training
+
+    try:
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "min_data_in_leaf": 10, "verbose": 0,
+                   "telemetry": True,
+                   "telemetry_dir": str(tmp_path / "conc")},
+                  lgb.Dataset(x, y), num_boost_round=30, callbacks=[cb])
+    finally:
+        stop.set()
+        for t in threads:
+            if t.ident is not None:
+                t.join(timeout=30)
+        if "srv" in holder:
+            stop_trainz(holder["srv"])
+    assert not errors, errors
+    assert scrapes, "no scrape overlapped the training run"
+
+
+def test_memory_compile_spans_records_land_in_journal(tmp_path):
+    """Iteration boundaries append `memory` watermarks; close drains
+    the span ring into ONE `spans` record (telemetry_trace knob) and
+    everything validates against the schema."""
+    bst = _train(tmp_path, "intro", n_rounds=3, telemetry_trace=True)
+    g = bst.gbdt
+    jdir = g.journal.directory
+    g.close_telemetry()
+    records, bad = read_journal(journal_path(jdir, 0))
+    assert bad == 0
+    for rec in records:
+        assert validate_record(rec) == [], rec
+    mems = [r for r in records if r["event"] == "memory"]
+    # one per iteration/BLOCK boundary (the fused path emits one record
+    # per compiled block) + the final close-time drain
+    assert len(mems) >= 2
+    assert all(m["host_rss_bytes"] > 0 for m in mems)
+    assert all(m["iteration"] >= 0 for m in mems)
+    dumps = [r for r in records if r["event"] == "spans"]
+    assert len(dumps) == 1     # once-only, even if close runs twice
+    assert dumps[0]["epoch_ts"] > 0
+    assert dumps[0]["spans"], "span ring dump is empty"
+    assert all("tid" in s and "start_s" in s for s in dumps[0]["spans"])
+    # registry gauges mirror the latest memory sample
+    assert g.metrics.gauge("host_rss_bytes").value > 0
+
+
+def test_export_trace_multirank_crash_restart(tmp_path):
+    """The acceptance shape: a 2-rank crash -> restart -> resume
+    journal exports to ONE valid Chrome trace-event JSON with per-rank
+    tracks covering iterations, the abort and the restart."""
+    from lightgbm_tpu.telemetry import export
+    d = str(tmp_path)
+    j0 = RunJournal(d, rank=0, meta={"num_ranks": 2})
+    j1 = RunJournal(d, rank=1, meta={"num_ranks": 2})
+    for i in (1, 2):
+        j0.iteration(i, phases={"build": 0.01, "score_upd": 0.002})
+        j1.iteration(i, phases={"build": 0.012})
+    j1.event("abort", exit_code=117, reason="collective_watchdog",
+             collective="tree_build", iteration=3)
+    j0.event("restart", attempt=1, exit_code=117, source="supervisor")
+    j0.event("resume", iteration=2)
+    j0.event("memory", iteration=2, host_rss_bytes=123456789)
+    j0.event("checkpoint", iteration=2, path="snap", write_s=0.004)
+    j0.event("compile", label="fused_scan_2it", seconds=0.5,
+             cache_hit=False)
+    j0.event("spans", epoch_ts=time.time() - 1.0,
+             spans=[{"name": "build", "path": "train/build",
+                     "start_s": 0.5, "duration_s": 0.01, "tid": 1111},
+                    {"name": "hb", "path": "hb",
+                     "start_s": 0.6, "duration_s": 0.002, "tid": 2222}])
+    j0.event("run_end", iterations=2)
+    j0.close()
+    j1.close()
+
+    trace, out_path = export.export_trace(d)
+    assert export.validate_trace(trace) == []
+    with open(out_path, encoding="utf-8") as f:
+        loaded = json.load(f)          # the verify-obs roundtrip
+    assert export.validate_trace(loaded) == []
+    events = loaded["traceEvents"]
+    by_pid = {e["pid"] for e in events}
+    assert by_pid == {0, 1}            # one process track per rank
+    names = [e["name"] for e in events]
+    assert "iteration 1" in names and "iteration 2" in names
+    assert any(n.startswith("abort exit=117") for n in names)
+    assert any(n.startswith("restart attempt=1") for n in names)
+    assert any(n.startswith("resume @2") for n in names)
+    assert any(n.startswith("compile fused_scan_2it") for n in names)
+    assert any(n.startswith("checkpoint @2") for n in names)
+    # phase children lie INSIDE their iteration slice
+    it0 = next(e for e in events if e["name"] == "iteration 1"
+               and e["pid"] == 0)
+    build = next(e for e in events if e["name"] == "build"
+                 and e["pid"] == 0 and e["tid"] == export.TID_TRAIN)
+    assert it0["ts"] <= build["ts"]
+    assert build["ts"] + build["dur"] <= it0["ts"] + it0["dur"] + 1
+    # the spans dump lands on per-thread lanes
+    span_lanes = {e["tid"] for e in events
+                  if e.get("ph") == "X" and e["tid"] >= export.TID_SPAN_BASE}
+    assert len(span_lanes) == 2
+    # memory became a counter track Perfetto can plot
+    assert any(e["ph"] == "C" and e["name"] == "memory_bytes"
+               for e in events)
+    # supervisor-sourced records get their own thread lane
+    sup = next(e for e in events if e["name"].startswith("restart"))
+    assert sup["tid"] == export.TID_SUPERVISOR
+    # timestamps rebased: everything starts at/after t=0
+    assert min(e["ts"] for e in events if e["ph"] != "M") >= 0
+
+
+def test_export_trace_cli(tmp_path):
+    """tools/export_trace.py end to end: journal dir -> trace.json on
+    disk, --validate runs the invariant check."""
+    d = str(tmp_path)
+    j = RunJournal(d, rank=0)
+    j.iteration(1, phases={"build": 0.01})
+    j.event("run_end", iterations=1)
+    j.close()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    r = subprocess.run([sys.executable, "tools/export_trace.py", d,
+                        "--validate"], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "trace invariants OK" in r.stdout
+    with open(os.path.join(d, "trace.json"), encoding="utf-8") as f:
+        trace = json.load(f)
+    assert trace["traceEvents"]
+    # empty dir exits 2, not a stack trace
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r2 = subprocess.run([sys.executable, "tools/export_trace.py",
+                         str(empty)], cwd=REPO, env=env,
+                        capture_output=True, text=True, timeout=120)
+    assert r2.returncode == 2
+
+
+def test_structured_log_record_modes(capsys, monkeypatch):
+    """Log.structured: one JSON object (fields merged) in JSON mode,
+    `event k=v` text otherwise — the serving access-log contract."""
+    from lightgbm_tpu.utils.log import Log
+    monkeypatch.delenv("LIGHTGBM_TPU_LOG_JSON", raising=False)
+    Log.structured("Info", "access", request_id="r1", path="/predict",
+                   rows=3, status=200)
+    out = capsys.readouterr().out
+    assert "access request_id=r1 path=/predict rows=3 status=200" in out
+    monkeypatch.setenv("LIGHTGBM_TPU_LOG_JSON", "1")
+    Log.structured("Warning", "slow_request", request_id="r2",
+                   total_ms=12.5)
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["event"] == "slow_request" and rec["level"] == "Warning"
+    assert rec["request_id"] == "r2" and rec["total_ms"] == 12.5
+    # gated below the active level: nothing is written
+    monkeypatch.setattr(Log, "_level", 0)
+    Log.structured("Info", "access", request_id="r3")
+    assert capsys.readouterr().out == ""

@@ -73,27 +73,54 @@ def expand(paths):
 
 
 def run_demo():
-    """Train 3 iterations with telemetry on and lint the journal —
-    proves the writer honors the schema end to end."""
+    """Train 3 iterations with telemetry (and the span-ring dump) on,
+    lint the journal — proving the writer honors the schema end to end,
+    including the memory/compile/spans introspection records — then
+    round-trip it through the trace exporter: export -> json.load ->
+    event invariants (the `make verify-obs` acceptance path)."""
+    import json as json_mod
     import shutil
     import tempfile
 
     import numpy as np
 
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.telemetry import export
 
     d = tempfile.mkdtemp(prefix="journal_demo_")
     try:
         rng = np.random.RandomState(7)
         x = rng.rand(300, 4)
         y = (x[:, 0] + x[:, 1] > 1).astype(float)
-        lgb.train({"objective": "binary", "num_leaves": 7,
-                   "min_data_in_leaf": 10, "verbose": 0,
-                   "telemetry": True, "telemetry_dir": d},
-                  lgb.Dataset(x, y), num_boost_round=3)
+        booster = lgb.train({"objective": "binary", "num_leaves": 7,
+                             "min_data_in_leaf": 10, "verbose": 0,
+                             "telemetry": True, "telemetry_dir": d,
+                             "telemetry_trace": True},
+                            lgb.Dataset(x, y), num_boost_round=3)
+        # end the run the way a finishing process does: the close drains
+        # the final introspection records + the span-ring dump
+        booster.gbdt.close_telemetry()
         rc = main([d])
         print("demo journal lint:", "OK" if rc == 0 else "FAILED")
-        return rc
+        if rc != 0:
+            return rc
+        events = {rec.get("event")
+                  for rec in export.collect_records(d)[0]}
+        for required in ("memory", "spans"):
+            if required not in events:
+                print(f"demo journal: no `{required}` record — the "
+                      "introspection drain is broken")
+                return 1
+        _, out_path = export.export_trace(d)
+        with open(out_path, encoding="utf-8") as f:
+            trace = json_mod.load(f)
+        errors = export.validate_trace(trace)
+        for err in errors:
+            print(f"trace roundtrip: {err}", file=sys.stderr)
+        print("demo trace-export roundtrip:",
+              "OK" if not errors else "FAILED",
+              f"({len(trace['traceEvents'])} events)")
+        return 1 if errors else 0
     finally:
         shutil.rmtree(d, ignore_errors=True)
 

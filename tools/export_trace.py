@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Export a run journal as Chrome trace-event JSON for Perfetto.
+
+Turns the journal files of one training run (telemetry/journal.py;
+every `journal.rank*.jsonl` under a directory, or one explicit JSONL
+file) into a single trace-event JSON timeline
+(telemetry/export.py): per-rank process tracks, iteration/phase
+slices, checkpoint/compile slices, abort/restart/resume flags,
+memory/metric counter tracks, and — when the run had
+`telemetry_trace=true` — fine-grained per-thread span slices.
+
+Open the output at https://ui.perfetto.dev (or chrome://tracing):
+a multi-rank crash -> restart -> resume run reads as one zoomable
+timeline.
+
+Usage:
+    python tools/export_trace.py <journal-dir-or-file> [-o trace.json]
+    python tools/export_trace.py <dir> --validate
+
+Exit codes: 0 = written (and valid), 1 = invariant violations in the
+built trace, 2 = no journal records found.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.telemetry import export  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python tools/export_trace.py",
+        description="Run journal -> Chrome trace-event JSON "
+                    "(docs/Observability.md)")
+    ap.add_argument("source",
+                    help="journal directory (rank files) or one .jsonl")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default <dir>/trace.json)")
+    ap.add_argument("--validate", action="store_true",
+                    help="run the trace invariant check after export "
+                         "(the make verify-obs round-trip)")
+    args = ap.parse_args(argv)
+
+    try:
+        trace, out_path = export.export_trace(args.source, args.out)
+    except ValueError as e:
+        print(f"export_trace: {e}", file=sys.stderr)
+        return 2
+    events = trace["traceEvents"]
+    ranks = sorted({e.get("pid") for e in events})
+    named = sum(e.get("ph") == "M" for e in events)
+    span_ms = max((e.get("ts", 0) + e.get("dur", 0)
+                   for e in events if e.get("ph") != "M"), default=0) / 1e3
+    print(f"export_trace: {len(events)} events ({named} metadata), "
+          f"{len(ranks)} rank track(s) {ranks}, {span_ms:.1f} ms span "
+          f"-> {out_path}")
+    if args.validate:
+        errors = export.validate_trace(trace)
+        for err in errors:
+            print(f"export_trace: INVALID: {err}", file=sys.stderr)
+        if errors:
+            return 1
+        print("export_trace: trace invariants OK")
+    print("open in https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

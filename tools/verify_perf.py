@@ -28,6 +28,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PATH = os.path.join(REPO, "BENCH_BASELINE.json")
 TOL = float(os.environ.get("VERIFY_PERF_TOL", "0.15"))
 AUC_TOL = 0.002
+# peak-memory regression gate over the baseline's recorded watermark
+# (host RSS on the CPU rung; bytes_in_use where the backend has
+# allocator stats) — 25% headroom absorbs allocator noise while still
+# catching a leaked score copy or an accidental densification
+MEM_TOL = float(os.environ.get("VERIFY_PERF_MEM_TOL", "0.25"))
 
 
 def run_cpu_rung(rows, iters, timeout_s):
@@ -70,7 +75,40 @@ def check_speed():
     if res["phases"].get("hist_bytes_per_s"):
         print(f"verify-perf: hist effective bandwidth "
               f"{res['phases']['hist_bytes_per_s'] / 1e9:.2f} GB/s")
-    return ok_speed and ok_auc
+    ok_mem = check_memory(base, res)
+    return ok_speed and ok_auc and ok_mem
+
+
+def check_memory(base, res):
+    """>MEM_TOL peak-memory regression vs the committed baseline fails
+    (PR 8; baseline field `peak_memory_bytes`, the bench child's
+    introspection watermark). A baseline without the field passes with
+    a note — re-measure and bump BENCH_BASELINE.json to arm it."""
+    intro = res.get("introspection") or {}
+    # device watermark where the backend publishes allocator stats
+    # (TPU/GPU); host peak RSS on this image's CPU jax
+    peak = intro.get("device_peak_bytes") or intro.get(
+        "host_peak_rss_bytes")
+    led = intro.get("compile_ledger") or {}
+    if led:
+        print(f"verify-perf: compile ledger: {led.get('compiles', 0)} "
+              f"compile(s) {led.get('total_s', 0.0):.2f}s, "
+              f"{led.get('cache_hits', 0)} persistent-cache hit(s)")
+    base_peak = base.get("peak_memory_bytes")
+    if not base_peak:
+        print("verify-perf: baseline has no peak_memory_bytes — memory "
+              "gate skipped (bump BENCH_BASELINE.json to arm)")
+        return True
+    if not peak:
+        print("verify-perf: bench child reported no memory watermark "
+              "-> MISSING")
+        return False
+    limit = base_peak * (1.0 + MEM_TOL)
+    ok = peak <= limit
+    print(f"verify-perf: peak memory {peak / 1e6:.0f} MB vs baseline "
+          f"{base_peak / 1e6:.0f} MB (limit {limit / 1e6:.0f} MB) -> "
+          f"{'OK' if ok else 'REGRESSION'}")
+    return ok
 
 
 def check_journal_tracer_consistency():
