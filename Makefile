@@ -66,6 +66,19 @@ verify-obs:
 verify-perf:
 	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py
 
+# model-quality suite: split-ledger importance parity (split/gain vs
+# reference semantics, bit-identical across serial/compacted/fused/
+# out-of-core learners), dataset-profile capture + persistence
+# roundtrips (binary cache, block store, model-file sidecar), PSI
+# math, and the drift/skew e2e (train -> profile -> serve -> shifted
+# replay trips psi_warn on /driftz + Prometheus + the structured log
+# while unshifted traffic stays quiet) — tier-1 pytest flags, hard
+# timeout
+verify-quality:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_quality.py tests/test_drift.py -q -m 'not slow' \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+
 # out-of-core suite: block-store build/validate/reuse, streamed-vs-
 # in-RAM bitwise parity across objectives/sampling, crash->resume,
 # corrupt-store detection — then the acceptance guard (bench ooc_probe
@@ -80,4 +93,4 @@ clean:
 	rm -f $(TARGET)
 
 .PHONY: all test-capi verify-fault verify-dist verify-serve verify-obs \
-	verify-perf verify-ooc clean
+	verify-perf verify-quality verify-ooc clean

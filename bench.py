@@ -392,6 +392,7 @@ def train_once(n_rows, n_iters=NUM_ITERATIONS):
     phases.update(checkpoint_probe(booster, train_s))
     phases.update(supervisor_probe())
     phases.update(telemetry_probe(booster, train_s, n_iters))
+    phases.update(quality_probe(booster, x, train_s, n_iters))
     # introspection-layer summary for the result JSON: what the run
     # compiled (telemetry/ledger.py; verify_perf tracks the totals) and
     # its memory watermarks (the >25% peak-memory regression gate)
@@ -474,6 +475,109 @@ def telemetry_probe(booster, train_s, n_iters):
         _mark(f"telemetry probe failed: {e}")
     finally:
         shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
+def quality_probe(booster, x, train_s, n_iters):
+    """Price the model-quality observability layer (ISSUE 9 bar: <1%
+    on BOTH sides). Training side: one full split-ledger pass over the
+    run's trees + a `quality` journal record into a throwaway journal
+    (median of 3) — `quality_train_overhead_pct` is that cost as a
+    percentage of measured train time (the fused path materializes its
+    trees host-side anyway, so the ledger is pure numpy). Serving
+    side: drift + skew monitors at their DEFAULT sample rates fed
+    request-sized chunks of the bench rows, priced against one
+    CompiledPredictor batch predict over the same rows —
+    `quality_serving_overhead_pct` is monitor seconds as a percentage
+    of serve seconds; tools/verify_perf.py guards both."""
+    import shutil
+    import tempfile
+
+    from lightgbm_tpu.telemetry.journal import RunJournal
+    from lightgbm_tpu.telemetry.quality import QualityTracker
+
+    out = {}
+    models = list(booster.models)
+    if not models:
+        return out
+    d = tempfile.mkdtemp(prefix="bench_quality_")
+    try:
+        probe_journal = RunJournal(d, rank=0, emit_run_start=False)
+        trials = []
+        for _ in range(3):
+            tracker = QualityTracker(booster.max_feature_idx + 1,
+                                     booster.feature_names)
+            t0 = time.time()
+            delta = tracker.sync(models)
+            probe_journal.event("quality", iteration=n_iters,
+                                **(delta or {}))
+            trials.append(time.time() - t0)
+        probe_journal.close()
+        ledger_s = sorted(trials)[1]   # the WHOLE run's ledger cost
+        out["quality_ledger_s"] = round(ledger_s, 6)
+        if train_s > 0:
+            out["quality_train_overhead_pct"] = round(
+                100.0 * ledger_s / train_s, 4)
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"quality ledger probe failed: {e}")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    try:
+        from lightgbm_tpu.io.profile import DatasetProfile
+        from lightgbm_tpu.serving import CompiledPredictor
+        from lightgbm_tpu.serving.drift import (DriftMonitor, SkewMonitor,
+                                                host_reference_scorer)
+
+        profile = booster.dataset_profile
+        if profile is None and booster.train_data is not None:
+            # a pre-profile binary dataset cache fed this run: rebuild
+            # the baseline from the resident bins (one bincount pass)
+            profile = DatasetProfile.from_dataset(booster.train_data)
+        if profile is None:
+            return out
+        rows = np.ascontiguousarray(x[:min(len(x), 100_000)], np.float32)
+        pred = CompiledPredictor.from_booster(booster,
+                                              max_batch_rows=4096)
+        pred.predict(rows[:4096])  # warm outside the timed window
+        t0 = time.time()
+        served = pred.predict(rows)
+        serve_s = max(time.time() - t0, 1e-9)
+        # default sample rates + the production reference path (model
+        # file -> host f64 scorer), i.e. the shipped configuration
+        d = tempfile.mkdtemp(prefix="bench_quality_")
+        try:
+            model_path = os.path.join(d, "model.txt")
+            booster.save_model_to_file(-1, model_path)
+            reference = host_reference_scorer(model_path)
+            chunk = 512                    # request-sized intake; the
+            dts, sts = [], []              # final flush prices ALL the
+            for _ in range(3):             # deferred work (median of 3)
+                drift = DriftMonitor(profile)
+                t0 = time.time()
+                for s in range(0, len(rows), chunk):
+                    drift.observe(rows[s:s + chunk],
+                                  predictions=served[s:s + chunk])
+                drift.flush()
+                dts.append(time.time() - t0)
+                skew = SkewMonitor(reference)
+                t0 = time.time()
+                for s in range(0, len(rows), chunk):
+                    skew.observe(rows[s:s + chunk],
+                                 served[s:s + chunk], "predict")
+                skew.flush()
+                sts.append(time.time() - t0)
+            drift_s, skew_s = sorted(dts)[1], sorted(sts)[1]
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+        out["quality_drift_row_s"] = round(drift_s / len(rows), 9)
+        out["quality_skew_row_s"] = round(skew_s / len(rows), 9)
+        out["quality_drift_rows_sampled"] = int(drift.rows_sampled)
+        out["quality_skew_rows_checked"] = int(skew.rows_checked)
+        out["quality_serving_overhead_pct"] = round(
+            100.0 * (drift_s + skew_s) / serve_s, 4)
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"quality serving probe failed: {e}")
     return out
 
 

@@ -502,16 +502,16 @@ class Booster:
         return self.gbdt.dump_model()
 
     def feature_importance(self, importance_type="split"):
-        """ndarray of per-feature split counts (basic.py:1587-1601)."""
-        if importance_type != "split":
-            raise LightGBMError("Unknown importance type: only 'split' is "
-                                "supported by this snapshot")
-        n = self.gbdt.max_feature_idx + 1
-        imp = np.zeros(n, dtype=np.int64)
-        for tree in self.gbdt.models:
-            for s in range(tree.num_leaves - 1):
-                imp[tree.split_feature_real[s]] += 1
-        return imp
+        """Per-feature importance ndarray from the split ledger
+        (telemetry/quality.py), reference semantics: `split` = int64
+        count of splits using the feature (basic.py:1587-1601),
+        `gain` = float64 sum of split gain over those splits (the
+        C API's LGBM_BoosterFeatureImportance gain variant)."""
+        if importance_type not in ("split", "gain"):
+            raise LightGBMError(
+                f"Unknown importance type {importance_type!r}: expected "
+                "'split' or 'gain'")
+        return self.gbdt.feature_importance_values(importance_type)
 
     # ---------------------------------------------------------------- attrs
     def attr(self, key):

@@ -273,6 +273,39 @@ class Config:
     # flag mirrors it); 0 = off
     slow_request_ms: float = 1000.0
 
+    # --- model-quality observability (telemetry/quality.py,
+    # io/profile.py, serving/drift.py; no reference equivalent beyond
+    # the feature_importance C API) ---
+    # journal one `quality` record per iteration/block: split ledger
+    # deltas (splits/gain, top features by gain), leaf-value
+    # distribution, importance drift; surfaced on /trainz + Prometheus.
+    # Requires `telemetry` for the journal; gauges work without it.
+    quality_telemetry: bool = False
+    # drift comparisons fold each feature's bins into at most this many
+    # contiguous groups before PSI (both the training profile baseline
+    # and the serving-side rolling histogram fold identically); <= 0 =
+    # native mapper resolution
+    profile_bins: int = 10
+    # serving drift monitor: fraction of request rows run through the
+    # bin mappers for the rolling histograms (the `--drift-sample-rate`
+    # serve flag mirrors it); 0 = drift monitoring off. The default is
+    # sized so the monitor stays under 1% of the raw predict pipe
+    # (serving/drift.py cost model); raise it on low-traffic services
+    drift_sample_rate: float = 0.001
+    # per-feature PSI at or above this emits a structured drift_warn
+    # log line and counts into drift_features_over_warn (0.2 is the
+    # conventional "investigate" threshold)
+    psi_warn: float = 0.2
+    # serving skew monitor: fraction of request rows shadow-scored
+    # through the host f64 reference path (`--skew-sample-rate`);
+    # 0 = skew monitoring off. One diverging row already warns, so a
+    # trickle suffices to catch systematic skew
+    skew_sample_rate: float = 0.0001
+    # structured skew_warn once the diverging-row count reaches this
+    # (the serving path is bit-exact vs the reference, so the first
+    # skewed row is already a bug); 0 = never warn
+    skew_warn: int = 1
+
     # --- fault tolerance (utils/checkpoint.py; no reference equivalent) ---
     snapshot_freq: int = 0     # checkpoint every k iterations (0 = off)
     snapshot_dir: str = ""     # default: <output_model>.snapshots
@@ -503,6 +536,12 @@ class Config:
               "roofline_warn_fraction in [0, 1]")
         check(self.slow_request_ms >= 0,
               "slow_request_ms should be >= 0")
+        check(0.0 <= self.drift_sample_rate <= 1.0,
+              "drift_sample_rate in [0, 1]")
+        check(0.0 <= self.skew_sample_rate <= 1.0,
+              "skew_sample_rate in [0, 1]")
+        check(self.psi_warn >= 0.0, "psi_warn should be >= 0")
+        check(self.skew_warn >= 0, "skew_warn should be >= 0")
         check(self.max_bad_rows >= 0, "max_bad_rows should be >= 0")
         check(self.device_predict_cells > 0,
               "device_predict_cells should be > 0")

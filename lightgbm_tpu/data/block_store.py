@@ -538,6 +538,13 @@ def build_block_store_from_file(loader, filename, directory):
     label = np.empty(n, dtype=np.float32)
     weights = np.empty(n, dtype=np.float32) if weight_idx >= 0 else None
     qid = np.empty(n, dtype=np.float64) if group_idx >= 0 else None
+    # dataset profile accumulates DURING the streaming bin pass — the
+    # (F, N) matrix never exists, so this is the only moment the full
+    # occupancy is observable in O(block) memory (io/profile.py)
+    from ..io.profile import profiling_enabled
+    occ = ([np.zeros(m.num_bin, np.int64) for m in mappers]
+           if profiling_enabled() else None)
+    miss = np.zeros(len(mappers), np.int64)
     binned = None
     for start, block in prefetch_blocks(
             iter_blocks(filename, fmt, cfg.has_header, num_cols)):
@@ -553,6 +560,12 @@ def build_block_store_from_file(loader, filename, directory):
         for u, j in enumerate(real_idx):
             binned[u, :len(block)] = \
                 mappers[u].value_to_bin(feats_block[:, j]).astype(dtype)
+            if occ is not None:
+                nb = len(occ[u])
+                occ[u] += np.bincount(
+                    binned[u, :len(block)].astype(np.int64),
+                    minlength=nb)[:nb]
+                miss[u] += int(np.isnan(feats_block[:, j]).sum())
         writer.append(binned[:, :len(block)])
 
     meta = Metadata(n)
@@ -573,6 +586,10 @@ def build_block_store_from_file(loader, filename, directory):
     proto.real_feature_idx = np.asarray(real_idx, dtype=np.int32)
     proto.label_idx = label_idx
     proto.metadata = meta
+    if occ is not None:
+        from ..io.profile import DatasetProfile
+        proto.profile = DatasetProfile.from_parts(
+            mappers, real_idx, proto.feature_names, occ, n, missing=miss)
     writer.finish(_sidecar_arrays(proto),
                   source=source_signature(filename),
                   binning=_binning_signature(cfg))

@@ -323,6 +323,10 @@ def encode_dataset_sidecar(ds, arrays=None):
             arrays[f"bundle_{k}"] = np.asarray(v)
     for k, v in ds.metadata.to_dict().items():
         arrays[f"meta_{k}"] = np.asarray(v)
+    if getattr(ds, "profile", None) is not None:
+        # the baseline distribution rides both binary forms (counts +
+        # missing only — the mappers above already carry the bounds)
+        ds.profile.encode_sidecar(arrays)
     return arrays
 
 
@@ -351,6 +355,8 @@ def decode_dataset_sidecar(ds, z, truncated):
         ds.bundle_plan = BundlePlan.from_dict(bundle)
     meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
     ds.metadata = Metadata.from_dict(meta)
+    from .profile import DatasetProfile
+    ds.profile = DatasetProfile.decode_sidecar(z, ds)  # None pre-profile
     return ds
 
 
@@ -370,6 +376,11 @@ class CoreDataset:
         self.raw_data = None          # optional (N, C) float32 original values
         self.global_num_data = None   # set by per-rank loading (multi-host)
         self.bundle_plan = None       # io/bundling.py BundlePlan or None
+        # training-time baseline distribution (io/profile.py
+        # DatasetProfile): per-feature bin occupancy + missing counts,
+        # captured once at binning and persisted through the binary
+        # cache / block-store sidecar / model-file sidecar
+        self.profile = None
 
     # ------------------------------------------------------------ properties
     @property
@@ -959,6 +970,13 @@ class DatasetLoader:
                 for r in range(num_machines))
             Log.info("Rank %d/%d streamed rows [%d, %d) of %d (two-round)",
                      rank, num_machines, lo, hi, n)
+        else:
+            # baseline distribution over the full stored matrix (a
+            # rank-filtered block would profile one shard's slice —
+            # skip until the pod-scale mesh gathers global profiles)
+            from .profile import DatasetProfile, profiling_enabled
+            if profiling_enabled():
+                ds.profile = DatasetProfile.from_dataset(ds)
         Log.info("Number of data: %d, number of features: %d (two-round)",
                  n_local, len(mappers))
         return ds
@@ -1281,6 +1299,14 @@ class DatasetLoader:
         ds.used_feature_map = used_map
         ds.real_feature_idx = np.asarray(real_idx, dtype=np.int32)
         ds.metadata = meta
+        # baseline distribution: one bincount pass over the fresh bin
+        # matrix (+ NaN counts where the raw matrix is at hand) — the
+        # training-time half of the serving drift story
+        from .profile import DatasetProfile, count_missing, profiling_enabled
+        if profiling_enabled():
+            missing = (count_missing(src._m, ds.real_feature_idx)
+                       if isinstance(src, DenseColumns) else None)
+            ds.profile = DatasetProfile.from_dataset(ds, missing=missing)
         Log.info("Number of data: %d, number of features: %d", n, len(mappers))
         return ds
 

@@ -273,6 +273,7 @@ class _BlockSnapshots:
             gb.journal.event("truncate", iteration=int(gb.iter),
                              dropped_iters=int(dropped),
                              reason="early_stop_block")
+        gb._journal_quality()  # snap the split ledger to the kept trees
 
     def set_scores_at(self, t, with_train=False):
         """Point every bound updater's score at the post-iteration-t
@@ -350,6 +351,13 @@ class GBDT:
         self.metrics = MetricsRegistry()
         self.journal = None         # RunJournal when `telemetry` is on
         self._trainz_server = None
+        # model-quality observability (telemetry/quality.py): the split
+        # ledger tracker (`quality_telemetry` knob) and the training
+        # dataset's baseline distribution (io/profile.py), persisted
+        # next to every saved model file for the serving drift monitor
+        self.quality = None
+        self.dataset_profile = None
+        self._last_metric_values = {}
 
     # ------------------------------------------------------------------ init
     def init(self, config, train_data, objective, training_metrics=()):
@@ -397,6 +405,10 @@ class GBDT:
             self.max_feature_idx = train_data.num_total_features - 1
             self.label_idx = train_data.label_idx
             self.feature_names = list(train_data.feature_names)
+            # the dataset's training-time baseline distribution rides
+            # with the booster so save_model_to_file can persist it
+            # next to the model text (docs/Observability.md)
+            self.dataset_profile = getattr(train_data, "profile", None)
         self.train_data = train_data
         self.config = config
         # data_changed already init'ed the learner with this config
@@ -443,6 +455,15 @@ class GBDT:
                                              False))
         self._roofline_warn_fraction = float(
             getattr(config, "roofline_warn_fraction", 0.0) or 0.0)
+        # quality telemetry works with or without the journal: the
+        # split-ledger tracker always feeds the registry gauges
+        # (/trainz + Prometheus); `quality` journal records need
+        # `telemetry` on too
+        if (getattr(config, "quality_telemetry", False)
+                and self.quality is None and self.train_data is not None):
+            from ..telemetry.quality import QualityTracker
+            self.quality = QualityTracker(self.max_feature_idx + 1,
+                                          self.feature_names)
         if not getattr(config, "telemetry", False):
             return
         import weakref
@@ -481,13 +502,21 @@ class GBDT:
                 gbdt = ref()
                 return gbdt.iter if gbdt is not None else -1
 
+            def quality_fn():
+                gbdt = ref()
+                if gbdt is None or gbdt.quality is None:
+                    return None
+                return gbdt.quality.snapshot()
+
             self._trainz_server = trainz.start_trainz(
                 trainz.build_sources(
                     iteration_fn=iteration_fn,
                     tracer=self.tracer,
                     registry=self.metrics,
                     journal=self.journal,
-                    roofline_warn_fraction=self._roofline_warn_fraction),
+                    roofline_warn_fraction=self._roofline_warn_fraction,
+                    quality_fn=(quality_fn if self.quality is not None
+                                else None)),
                 port=port)
 
     def _journal_iteration(self, **fields):
@@ -519,6 +548,33 @@ class GBDT:
             self.journal.event("compile", label=entry["label"] or "jit",
                                seconds=round(entry["seconds"], 6),
                                cache_hit=bool(entry["cache_hit"]))
+
+    def _journal_quality(self):
+        """One `quality` record per completed iteration/block
+        (`quality_telemetry` knob): the split ledger's deltas
+        (splits/gain, top features by gain), the new trees' leaf-value
+        distribution, the normalized-gain-importance L1 shift, and the
+        latest eval metric values — the model-health timeline the
+        serving drift monitor's data-health timeline pairs with.
+        Registry gauges (quality_*) update even without a journal so
+        /trainz + Prometheus always carry the totals."""
+        if self.quality is None:
+            return
+        delta = self.quality.sync(self.models)
+        ledger = self.quality.ledger
+        self.metrics.set("quality_trees_total", int(ledger.n_trees))
+        self.metrics.set("quality_splits_total", int(ledger.n_splits))
+        self.metrics.set("quality_gain_total",
+                         float(ledger.gain_sums.sum()))
+        top = self.quality.snapshot()["top_features"]
+        if top:
+            self.metrics.set("quality_top_feature_gain",
+                             float(top[0]["gain"]))
+        if delta is not None and self.journal is not None:
+            if self._last_metric_values:
+                delta["values"] = dict(self._last_metric_values)
+            self.journal.event("quality", iteration=int(self.iter),
+                               **delta)
 
     @staticmethod
     def _rms(arr):
@@ -734,6 +790,7 @@ class GBDT:
                                     hess_norm=self._rms(hessians),
                                     leaf_count=int(new_leaves),
                                     **(extra or {}))
+        self._journal_quality()
         if is_eval:
             with self.tracer.phase("eval"):
                 return self.eval_and_check_early_stopping()
@@ -993,6 +1050,7 @@ class GBDT:
             self._journal_iteration(
                 block=int(t_eff), fused=True,
                 compile_cache_hit=bool(self.last_compile_cache_hit))
+        self._journal_quality()
         return stacked, t_eff, k_stop, n_before
 
     def _natural_stop_score_exact(self):
@@ -1092,6 +1150,11 @@ class GBDT:
                 updater.add_score_by_tree(tree, k)
         del self.models[-self.num_class:]
         self.iter -= 1
+        if self.quality is not None:
+            # snap the split ledger to the surviving trees NOW: a
+            # retrained iteration restores the old list LENGTH, which
+            # a later length-only sync could not tell from no change
+            self.quality.sync(self.models)
 
     # ------------------------------------------------------------ evaluation
     def eval_and_check_early_stopping(self):
@@ -1122,6 +1185,7 @@ class GBDT:
         if self.journal is not None:
             self.journal.event("truncate", iteration=int(self.iter),
                                dropped_iters=int(k), reason="early_stop")
+        self._journal_quality()  # snap the split ledger to the kept trees
 
     def output_metric(self, it):
         """gbdt.cpp:292-349: print metrics, track early stopping."""
@@ -1162,6 +1226,10 @@ class GBDT:
         msg = "\n".join(msg_lines)
         for i, j in met_pairs:
             self.best_msg[i][j] = msg
+        if met_values:
+            # latest eval values ride the next `quality` record too
+            # (per-iteration eval metrics in the model-health timeline)
+            self._last_metric_values = met_values
         if self.journal is not None and met_values:
             # metric values (train loss/AUC/...) in the same timeline as
             # the iteration records they describe
@@ -1178,6 +1246,14 @@ class GBDT:
         else:
             for metric in self.valid_metrics[data_idx - 1]:
                 out.extend(metric.eval(self.valid_score_updaters[data_idx - 1].host_score()))
+        if out:
+            # latest eval values ride the next `quality` record (the
+            # Python-API eval path; the CLI path lands here via
+            # output_metric's own loop)
+            prefix = "training" if data_idx == 0 else f"valid_{data_idx}"
+            self._last_metric_values.update(
+                {f"{prefix} {n}": float(v)
+                 for n, v in zip(self.get_eval_names(data_idx), out)})
         return out
 
     def get_eval_names(self, data_idx):
@@ -1405,7 +1481,13 @@ class GBDT:
         """Route a predict_raw call host vs device. The env flag wins
         when set ("0"/"false" forces host, "force"/"true" forces
         device), else the `device_predict` config knob, else the
-        cells-threshold auto rule (docs/Parameters.md)."""
+        cells-threshold auto rule (docs/Parameters.md).
+        `force_host_predict` beats even the env: a booster serving as
+        a PRECISION REFERENCE (serving/drift.py host_reference_scorer)
+        must stay on the host f64 path no matter how the deployment
+        tunes its own predictors."""
+        if getattr(self, "force_host_predict", False):
+            return False
         knob = os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT")
         if knob in (None, "", "1"):  # "1" was the legacy auto default
             knob = str(getattr(self, "device_predict", "auto"))
@@ -1476,12 +1558,19 @@ class GBDT:
         return np.concatenate(outs, axis=0)
 
     # --------------------------------------------------------- serialization
+    def feature_importance_values(self, importance_type="split"):
+        """Reference-semantics importance vector over the model list
+        (telemetry/quality.py — the ONE aggregation every consumer
+        shares): int64 split counts or float64 gain sums, length
+        max_feature_idx + 1."""
+        from ..telemetry.quality import feature_importance_from_models
+        return feature_importance_from_models(
+            self.models, self.max_feature_idx + 1, importance_type)
+
     def feature_importance(self):
-        """Split-count importance (gbdt.cpp:585-610)."""
-        imp = np.zeros(self.max_feature_idx + 1, dtype=np.int64)
-        for tree in self.models:
-            for s in range(tree.num_leaves - 1):
-                imp[tree.split_feature_real[s]] += 1
+        """Split-count importance pairs for the model file's
+        "feature importances:" block (gbdt.cpp:585-610)."""
+        imp = self.feature_importance_values("split")
         pairs = [(int(imp[i]), self.feature_names[i] if i < len(self.feature_names)
                   else f"Column_{i}") for i in range(len(imp)) if imp[i] > 0]
         pairs.sort(key=lambda p: -p[0])
@@ -1514,6 +1603,16 @@ class GBDT:
         # model where a valid one stood (utils/checkpoint.py)
         from ..utils.checkpoint import atomic_write_text
         atomic_write_text(filename, self.save_model_to_string(num_iteration))
+        if self.dataset_profile is not None:
+            # the training-time baseline distribution travels with the
+            # model: <model>.profile.json is what the serving drift
+            # monitor loads (io/profile.py, serving/drift.py)
+            from ..io.profile import model_profile_path
+            try:
+                self.dataset_profile.save(model_profile_path(filename))
+            except OSError as e:
+                Log.warning("could not write dataset profile next to "
+                            "%s: %s", filename, e)
 
     def load_model_from_string(self, model_str):
         """gbdt.cpp:515-583."""

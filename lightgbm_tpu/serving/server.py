@@ -10,7 +10,12 @@ micro-batching queue (serving/batcher.py) and serves:
 - GET  /healthz          liveness + model card
 - GET  /metricz          request/row/batch counters, batch occupancy,
                          queue depth, p50/p95/p99 latency, warmup +
-                         compile-cache stats
+                         compile-cache stats, drift/skew gauges
+- GET  /driftz           the drift & skew monitors' full view: rolling
+                         per-feature PSI vs the training profile,
+                         prediction-distribution histogram, shadow-
+                         scoring skew counters (serving/drift.py;
+                         requires a <model>.profile.json baseline)
 
 Request body: JSON `{"rows": [[...], ...]}` (or `{"row": [...]}` for a
 single row), or `text/csv` — one comma/tab-separated row per line.
@@ -86,6 +91,8 @@ class ServingHandler(BaseHTTPRequestHandler):
     metrics = None
     predictor = None
     slow_request_ms = DEFAULT_SLOW_REQUEST_MS
+    drift = None     # serving/drift.py DriftMonitor (or None)
+    skew = None      # serving/drift.py SkewMonitor (or None)
 
     def log_message(self, fmt, *args):
         # the structured access-log record (one per request, with id +
@@ -131,6 +138,12 @@ class ServingHandler(BaseHTTPRequestHandler):
         snap["warm_dispatches"] = stats["warm_dispatches"]
         snap["cold_dispatches"] = stats["cold_dispatches"]
         snap["buckets"] = stats["buckets"]
+        # drift/skew scalar gauges ride the same page (full view on
+        # /driftz); absent monitors contribute nothing
+        if self.drift is not None:
+            snap.update(self.drift.gauges())
+        if self.skew is not None:
+            snap.update(self.skew.gauges())
         return snap
 
     def _prometheus(self):
@@ -145,6 +158,11 @@ class ServingHandler(BaseHTTPRequestHandler):
                  if k not in owned
                  and isinstance(v, (int, float))
                  and not isinstance(v, bool)}
+        if self.drift is not None:
+            # one gauge per profiled feature: the scrape-side alerting
+            # surface (`lightgbm_tpu_drift_psi_<feature>`)
+            for name, value in self.drift.psi_by_feature().items():
+                extra[f"drift_psi_{name}"] = value
         return prometheus.render(reg, extra_gauges=extra)
 
     def do_GET(self):
@@ -153,6 +171,14 @@ class ServingHandler(BaseHTTPRequestHandler):
         if parts.path.startswith("/healthz"):
             self._reply(200, {"status": "ok",
                               "model": self.predictor.describe()})
+        elif parts.path.startswith("/driftz"):
+            out = {"enabled": self.drift is not None
+                   or self.skew is not None}
+            if self.drift is not None:
+                out.update(self.drift.snapshot())
+            out["skew"] = (self.skew.snapshot()
+                           if self.skew is not None else None)
+            self._reply(200, out)
         elif parts.path.startswith("/metricz"):
             if fmt == "prometheus":
                 data = self._prometheus().encode("utf-8")
@@ -250,13 +276,37 @@ class ServingHandler(BaseHTTPRequestHandler):
                            rows=int(rows.shape[0]),
                            threshold_ms=slow, **timing)
         self._access_log(req_id, rows.shape[0], 200, timing)
+        # drift/skew intake AFTER the reply: sampled monitoring must
+        # never add to the latency the client (or /metricz) sees
+        self._observe_quality(kind, rows, out)
+
+    def _observe_quality(self, kind, rows, out):
+        """Feed the drift monitor (sampled row histograms + the
+        prediction distribution) and the skew monitor (sampled host
+        f64 shadow scoring). Never raises — a monitor defect must not
+        poison the keep-alive connection."""
+        if self.drift is None and self.skew is None:
+            return
+        try:
+            if self.drift is not None:
+                # the monitor reduces multiclass outputs to the
+                # winning-class confidence at flush — pass the batcher
+                # output through untouched (request path stays cheap)
+                self.drift.observe(
+                    rows, predictions=out if kind == "predict" else None)
+            if self.skew is not None and kind in ("predict", "raw"):
+                self.skew.observe(rows, out, kind)
+        except Exception as e:
+            Log.warning("drift/skew monitor failed: %s", e)
 
 
 def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
                 max_batch_rows=None,
-                slow_request_ms=DEFAULT_SLOW_REQUEST_MS):
-    """Wire predictor + batcher + metrics into a ThreadingHTTPServer
-    (not yet serving — call serve_forever, or use it from tests)."""
+                slow_request_ms=DEFAULT_SLOW_REQUEST_MS,
+                drift=None, skew=None):
+    """Wire predictor + batcher + metrics (+ optional drift/skew
+    monitors, serving/drift.py) into a ThreadingHTTPServer (not yet
+    serving — call serve_forever, or use it from tests)."""
     metrics = ServingMetrics()
     batcher = MicroBatcher(predictor,
                            max_batch_rows=max_batch_rows,
@@ -264,11 +314,14 @@ def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
     handler = type("BoundServingHandler", (ServingHandler,),
                    {"batcher": batcher, "metrics": metrics,
                     "predictor": predictor,
-                    "slow_request_ms": float(slow_request_ms or 0.0)})
+                    "slow_request_ms": float(slow_request_ms or 0.0),
+                    "drift": drift, "skew": skew})
     srv = ThreadingHTTPServer((host, port), handler)
     srv.batcher = batcher
     srv.metrics = metrics
     srv.predictor = predictor
+    srv.drift = drift
+    srv.skew = skew
     return srv
 
 
@@ -293,16 +346,78 @@ def main(argv=None):
                          "slow_request_ms config knob)")
     ap.add_argument("--num-iteration", type=int, default=-1,
                     help="serve only the first N iterations of the model")
+    from .drift import (DEFAULT_DRIFT_SAMPLE_RATE, DEFAULT_PSI_WARN,
+                        DEFAULT_SKEW_SAMPLE_RATE, DEFAULT_SKEW_WARN)
+    from ..io.profile import DEFAULT_PROFILE_BINS, model_profile_path
+    ap.add_argument("--profile", default="",
+                    help="training dataset profile JSON (default: "
+                         "<model>.profile.json when it exists); the "
+                         "drift monitor's baseline distribution")
+    ap.add_argument("--drift-sample-rate", type=float,
+                    default=DEFAULT_DRIFT_SAMPLE_RATE,
+                    help="fraction of request rows fed to the drift "
+                         "monitor (0 = off; mirrors the "
+                         "drift_sample_rate config knob)")
+    ap.add_argument("--psi-warn", type=float, default=DEFAULT_PSI_WARN,
+                    help="per-feature PSI threshold for the structured "
+                         "drift_warn log (mirrors psi_warn)")
+    ap.add_argument("--profile-bins", type=int,
+                    default=DEFAULT_PROFILE_BINS,
+                    help="max histogram groups per feature for PSI "
+                         "(mirrors profile_bins)")
+    ap.add_argument("--skew-sample-rate", type=float,
+                    default=DEFAULT_SKEW_SAMPLE_RATE,
+                    help="fraction of request rows shadow-scored "
+                         "through the host f64 reference path (0 = "
+                         "off; mirrors skew_sample_rate)")
+    ap.add_argument("--skew-warn", type=int, default=DEFAULT_SKEW_WARN,
+                    help="diverging-row count that triggers the "
+                         "structured skew_warn log (mirrors skew_warn)")
     args = ap.parse_args(argv)
 
     t0 = time.time()
     predictor = CompiledPredictor.from_model_file(
         args.model, num_iteration=args.num_iteration,
         max_batch_rows=args.max_batch_rows)
+    drift = skew = None
+    if args.drift_sample_rate > 0:
+        import os
+        from ..io.profile import DatasetProfile
+        from .drift import DriftMonitor
+        profile_path = args.profile or model_profile_path(args.model)
+        if os.path.exists(profile_path):
+            profile = DatasetProfile.load(profile_path)
+            # transformed binary/multiclass predictions live in [0, 1]
+            pred_range = ((0.0, 1.0)
+                          if predictor.sigmoid > 0
+                          or predictor.num_class > 1 else None)
+            drift = DriftMonitor(profile,
+                                 sample_rate=args.drift_sample_rate,
+                                 psi_warn=args.psi_warn,
+                                 profile_bins=args.profile_bins,
+                                 pred_range=pred_range)
+            Log.info("drift monitor on: %d profiled features, sample "
+                     "rate %.3f, psi_warn %.2f (%s)",
+                     profile.num_features, args.drift_sample_rate,
+                     args.psi_warn, profile_path)
+        else:
+            Log.warning("drift monitor off: no training profile at %s "
+                        "(train with a build that writes "
+                        "<model>.profile.json, or pass --profile)",
+                        profile_path)
+    if args.skew_sample_rate > 0:
+        from .drift import SkewMonitor, host_reference_scorer
+        skew = SkewMonitor(host_reference_scorer(args.model),
+                           sample_rate=args.skew_sample_rate,
+                           skew_warn=args.skew_warn)
+        Log.info("skew monitor on: sample rate %.3f, warn at %d "
+                 "diverging row(s)", args.skew_sample_rate,
+                 args.skew_warn)
     srv = make_server(predictor, host=args.host, port=args.port,
                       max_wait_ms=args.max_wait_ms,
                       max_batch_rows=args.max_batch_rows,
-                      slow_request_ms=args.slow_request_ms)
+                      slow_request_ms=args.slow_request_ms,
+                      drift=drift, skew=skew)
     Log.info("serving %s on http://%s:%d (%d trees, load+warm %.2fs, "
              "%d compile-cache hits)", args.model, args.host, args.port,
              predictor.num_trees, time.time() - t0,

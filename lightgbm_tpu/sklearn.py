@@ -231,6 +231,13 @@ class LGBMModel(LGBMModelBase):
         importance = self._Booster.feature_importance().astype(np.float32)
         return importance / importance.sum()
 
+    @property
+    def feature_importances_(self):
+        """Raw split-count importances from the split ledger
+        (reference sklearn surface; `booster().feature_importance(
+        importance_type='gain')` for the gain variant)."""
+        return self.booster().feature_importance(importance_type="split")
+
 
 class LGBMRegressor(LGBMModel, LGBMRegressorBase):
 

@@ -14,8 +14,10 @@ a multi-rank crash → restart → resume run reads as one timeline:
 - **checkpoints** (`write_s`) and **compiles** (`seconds`) are slices;
   **aborts / restarts / resumes / run boundaries** are flagged instant
   events, so the watchdog's exit-117 story is visible at a glance;
-- **grad/hess norms, leaf counts, metric values and memory
-  watermarks** become counter tracks (Perfetto plots them);
+- **grad/hess norms, leaf counts, metric values, memory watermarks
+  and model-quality deltas** (`quality` records: gain/split deltas,
+  importance shift, eval values, drift psi_max / skew counts) become
+  counter tracks (Perfetto plots them);
 - a journal `spans` record (the recent-span ring dumped at close)
   becomes fine-grained slices on per-thread lanes — concurrent
   batcher/heartbeat threads get their own tracks via the span tid.
@@ -185,6 +187,17 @@ def build_trace(records):
                                            "leaf_count") if k in rec})
         elif event == "metrics":
             b.counter(rank, "metrics", ts, rec.get("values") or {})
+        elif event == "quality":
+            # model-quality counter track (quality_telemetry knob):
+            # split/gain deltas, importance drift, plus the serving-
+            # side psi_max/skew_count when a drift e2e journaled them;
+            # the record's eval values ride the same track so the
+            # metric curve lines up with the gain curve
+            vals = {k: rec[k] for k in ("gain_total", "splits", "trees",
+                                        "importance_shift", "psi_max",
+                                        "skew_count") if k in rec}
+            vals.update(rec.get("values") or {})
+            b.counter(rank, "quality", ts, vals)
         elif event == "memory":
             b.counter(rank, "memory_bytes", ts,
                       {k: rec[k] for k in ("device_bytes_in_use",
@@ -262,6 +275,16 @@ def validate_trace(trace):
             dur = e.get("dur")
             if not isinstance(dur, (int, float)) or dur <= 0:
                 errors.append(f"event {i}: X event needs dur > 0")
+        if e.get("ph") == "C":
+            # counter tracks (training_health, metrics, memory_bytes,
+            # quality) must carry a non-empty all-numeric args dict —
+            # Perfetto silently drops anything else
+            args = e.get("args")
+            if not isinstance(args, dict) or not args:
+                errors.append(f"event {i}: C event needs non-empty args")
+            elif any(not isinstance(v, (int, float))
+                     or isinstance(v, bool) for v in args.values()):
+                errors.append(f"event {i}: C event args must be numeric")
     try:
         json.dumps(trace, allow_nan=False)
     except (TypeError, ValueError) as exc:

@@ -66,6 +66,18 @@ SCHEMA = {
                                "prefetch_overlap_pct": float}},
     "metrics": {"required": {"iteration": int, "values": dict},
                 "optional": {}},
+    # model-quality deltas per iteration/block (`quality_telemetry`
+    # knob; telemetry/quality.py QualityTracker): split ledger deltas,
+    # top features by gain, leaf-value distribution of the new trees,
+    # normalized-gain-importance L1 shift, latest eval values; the
+    # serving-side drift e2e also journals psi_max/skew_count here
+    "quality": {"required": {"iteration": int},
+                "optional": {"trees": int, "splits": int,
+                             "gain_total": float, "top_gain": dict,
+                             "leaf_values": dict,
+                             "importance_shift": float, "values": dict,
+                             "psi_max": float, "skew_count": int,
+                             "source": str}},
     "checkpoint": {"required": {"iteration": int, "path": str},
                    "optional": {"write_s": float}},
     "resume": {"required": {"iteration": int},

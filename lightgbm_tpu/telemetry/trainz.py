@@ -141,7 +141,8 @@ class TrainzHandler(BaseHTTPRequestHandler):
 
 
 def build_sources(iteration_fn=None, tracer=None, registry=None,
-                  journal=None, tail_n=20, roofline_warn_fraction=0.0):
+                  journal=None, tail_n=20, roofline_warn_fraction=0.0,
+                  quality_fn=None):
     """Assemble the /trainz source map from whatever exists. The
     heartbeat service is resolved lazily per request (it may start
     after the endpoint does); memory/compile/roofline read the
@@ -154,6 +155,10 @@ def build_sources(iteration_fn=None, tracer=None, registry=None,
         sources["spans"] = tracer.recent
     if registry is not None:
         sources["metrics"] = registry.snapshot
+    if quality_fn is not None:
+        # split-ledger totals + top features by gain
+        # (telemetry/quality.py QualityTracker.snapshot)
+        sources["quality"] = quality_fn
 
     def heartbeats():
         from ..parallel import heartbeat

@@ -1,0 +1,446 @@
+"""Dataset profile + serving drift/skew suite (ISSUE 9, data side).
+
+- profile capture at binning (occupancy sums to the row count, zero
+  rates, mapper bounds preserved) on the matrix, text, two-round and
+  block-store build paths;
+- persistence roundtrips: binary dataset cache, block-store sidecar,
+  the <model>.profile.json model sidecar (inf bounds survive JSON);
+- PSI math: zero for identical distributions, small for same-source
+  samples, large for shifted ones; group folding alignment;
+- DriftMonitor / SkewMonitor unit behavior (sampling, warning
+  once-per-excursion, window decay, skew counting against the host
+  f64 reference);
+- the acceptance e2e: train -> profile persisted -> serve ->
+  deliberately shifted replay trips psi_warn on /driftz, Prometheus
+  /metricz and the structured warning log, while unshifted traffic
+  stays quiet.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.data.block_store import spill_core_dataset
+from lightgbm_tpu.io.dataset import CoreDataset, DatasetLoader
+from lightgbm_tpu.io.profile import (DatasetProfile, group_counts,
+                                     model_profile_path)
+from lightgbm_tpu.serving import CompiledPredictor
+from lightgbm_tpu.serving.drift import (DriftMonitor, SkewMonitor,
+                                        host_reference_scorer, psi)
+from lightgbm_tpu.serving.server import make_server
+
+BASE = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 10,
+        "learning_rate": 0.1, "verbose": -1}
+
+
+def _data(n=2000, f=4, seed=7):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, f)
+    y = (x[:, 0] + x[:, 1] > 1).astype(np.float64)
+    return x, y
+
+
+def _train(x, y, rounds=5, params=None):
+    p = dict(BASE, **(params or {}))
+    ds = lgb.Dataset(x, y, params=p)
+    return lgb.train(p, ds, num_boost_round=rounds), ds
+
+
+# ------------------------------------------------------------- profile
+
+def test_profile_capture_matrix_path():
+    x, y = _data()
+    _, ds = _train(x, y, rounds=1)
+    prof = ds._core.profile
+    assert prof is not None and prof.num_rows == len(x)
+    for u, rec in enumerate(prof.features):
+        assert int(rec["counts"].sum()) == len(x)
+        assert 0.0 <= prof.zero_rate(u) <= 1.0
+        # numeric features carry their mapper's bounds, +inf last
+        assert rec["upper_bounds"][-1] == np.inf
+        # the rebuilt mapper bins values identically to the dataset's
+        m = prof.mapper(u)
+        col = x[:, rec["column"]]
+        np.testing.assert_array_equal(
+            m.value_to_bin(col),
+            ds._core.bin_mappers[u].value_to_bin(col))
+
+
+def test_profile_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_DATASET_PROFILE", "0")
+    x, y = _data(n=500)
+    _, ds = _train(x, y, rounds=1)
+    assert ds._core.profile is None
+
+
+def test_profile_binary_cache_roundtrip(tmp_path):
+    x, y = _data()
+    _, ds = _train(x, y, rounds=1)
+    prof = ds._core.profile
+    path = str(tmp_path / "cache.bin")
+    ds._core.save_binary(path)
+    loaded = CoreDataset.load_binary(path)
+    assert loaded.profile is not None
+    assert loaded.profile.num_rows == prof.num_rows
+    for a, b in zip(prof.features, loaded.profile.features):
+        np.testing.assert_array_equal(a["counts"], b["counts"])
+        np.testing.assert_array_equal(a["upper_bounds"],
+                                      b["upper_bounds"])
+
+
+def test_profile_block_store_roundtrip(tmp_path):
+    x, y = _data()
+    _, ds = _train(x, y, rounds=1)
+    prof = ds._core.profile
+    ooc = spill_core_dataset(ds._core, str(tmp_path / "blocks"), 512)
+    assert ooc.profile is not None
+    for a, b in zip(prof.features, ooc.profile.features):
+        np.testing.assert_array_equal(a["counts"], b["counts"])
+    # recomputing from the streamed blocks matches the persisted one
+    recomputed = DatasetProfile.from_dataset(ooc)
+    for a, b in zip(prof.features, recomputed.features):
+        np.testing.assert_array_equal(a["counts"], b["counts"])
+
+
+def test_profile_block_store_file_build(tmp_path):
+    """The streaming file->block-store build accumulates the SAME
+    occupancy the in-RAM path computes (identical mappers by the
+    shared sample draw)."""
+    x, y = _data(n=1500)
+    data_file = str(tmp_path / "train.csv")
+    with open(data_file, "w") as f:
+        for i in range(len(x)):
+            f.write(",".join([str(y[i])] + [f"{v:.8f}" for v in x[i]])
+                    + "\n")
+    params = dict(BASE, out_of_core=True, block_rows=512,
+                  ooc_dir=str(tmp_path / "blocks"))
+    ds_ooc = lgb.Dataset(data_file, params=params).construct()
+    prof_ooc = ds_ooc._core.profile
+    assert prof_ooc is not None
+    ds_ram = lgb.Dataset(data_file, params=dict(BASE)).construct()
+    prof_ram = ds_ram._core.profile
+    for a, b in zip(prof_ram.features, prof_ooc.features):
+        np.testing.assert_array_equal(a["counts"], b["counts"])
+
+
+def test_profile_model_sidecar_roundtrip(tmp_path):
+    x, y = _data()
+    b, ds = _train(x, y)
+    model_path = str(tmp_path / "model.txt")
+    b.save_model(model_path)
+    sidecar = model_profile_path(model_path)
+    assert os.path.exists(sidecar)
+    loaded = DatasetProfile.load(sidecar)
+    prof = ds._core.profile
+    assert loaded.num_rows == prof.num_rows
+    for a, c in zip(prof.features, loaded.features):
+        np.testing.assert_array_equal(a["counts"], c["counts"])
+        # +inf upper bound survives the JSON null encoding
+        np.testing.assert_array_equal(a["upper_bounds"],
+                                      c["upper_bounds"])
+        assert a["name"] == c["name"]
+
+
+def test_group_counts_folding():
+    counts = np.arange(10, dtype=np.int64)
+    np.testing.assert_array_equal(group_counts(counts, 0), counts)
+    np.testing.assert_array_equal(group_counts(counts, 20), counts)
+    folded = group_counts(counts, 5)
+    assert len(folded) == 5
+    assert folded.sum() == counts.sum()
+    np.testing.assert_array_equal(folded, [1, 5, 9, 13, 17])
+
+
+# ----------------------------------------------------------------- psi
+
+def test_psi_math():
+    base = np.asarray([100, 100, 100, 100])
+    assert psi(base, base * 7) == pytest.approx(0.0, abs=1e-12)
+    # same-source sample: small
+    rng = np.random.RandomState(0)
+    sample = np.bincount(rng.randint(0, 4, 400), minlength=4)
+    assert psi(base, sample) < 0.05
+    # mass moved to one group: large
+    assert psi(base, np.asarray([400, 0, 0, 0])) > 0.5
+    # empty sides are "no signal", not infinity
+    assert psi(base, np.zeros(4)) == 0.0
+    assert psi(np.zeros(4), base) == 0.0
+
+
+def test_psi_small_sample_not_noisy():
+    """An empty observed group at small samples must not read as
+    drift (the Laplace smoothing contract)."""
+    base = np.full(10, 200)
+    rng = np.random.RandomState(1)
+    for _ in range(10):
+        sample = np.bincount(rng.randint(0, 10, 200), minlength=10)
+        assert psi(base, sample) < 0.2
+
+
+# ------------------------------------------------------- drift monitor
+
+def _profile_of(x, y):
+    _, ds = _train(x, y, rounds=1)
+    return ds._core.profile
+
+
+def test_drift_monitor_quiet_and_shifted():
+    x, y = _data()
+    prof = _profile_of(x, y)
+    mon = DriftMonitor(prof, sample_rate=1.0, psi_warn=0.2)
+    rng = np.random.RandomState(1)
+    mon.observe(rng.rand(600, 4))
+    assert mon.gauges()["drift_psi_max"] < 0.2
+    assert not mon.warnings
+    shifted = rng.rand(600, 4)
+    shifted[:, 0] += 3.0            # past the training range
+    mon.observe(shifted)
+    by_feat = mon.psi_by_feature()
+    name0 = prof.features[0]["name"]
+    assert by_feat[name0] >= 0.2
+    assert [w["feature"] for w in mon.warnings] == [name0]
+    # a second shifted batch does NOT re-warn (one per excursion)
+    mon.observe(shifted)
+    assert len(mon.warnings) == 1
+    snap = mon.snapshot()
+    assert snap["rows_sampled"] == 1800
+    assert snap["features"][name0]["psi"] >= 0.2
+
+
+def test_drift_monitor_sampling_and_window():
+    x, y = _data()
+    prof = _profile_of(x, y)
+    mon = DriftMonitor(prof, sample_rate=0.0)
+    mon.observe(np.random.rand(100, 4))
+    assert mon.rows_seen == 100 and mon.rows_sampled == 0
+    mon = DriftMonitor(prof, sample_rate=1.0, window_rows=500)
+    for _ in range(4):
+        mon.observe(np.random.rand(400, 4))
+    # decay: counts halve past 2x the window
+    assert mon.rows_sampled < 1600
+
+
+def test_drift_vectorized_binning_matches_mapper_fold():
+    """The monitor's broadcast group-edge binning must agree EXACTLY
+    with folding mapper.value_to_bin through group_counts' group map —
+    including NaN (-> zero bin), +-inf, and out-of-range values."""
+    x, y = _data()
+    prof = _profile_of(x, y)
+    mon = DriftMonitor(prof, sample_rate=1.0, profile_bins=3)
+    rng = np.random.RandomState(3)
+    rows = rng.rand(500, 4) * 4 - 1          # spills past train range
+    rows[::17, 1] = np.nan
+    rows[::29, 2] = np.inf
+    rows[::31, 3] = -np.inf
+    mon.observe(rows)
+    mon.flush()
+    for u, rec in enumerate(prof.features):
+        mapper = prof.mapper(u)
+        bins = mapper.value_to_bin(rows[:, rec["column"]]).astype(
+            np.int64)
+        g = int(mon._g[u])
+        nb = int(rec["num_bin"])
+        if nb > g:
+            bins = (bins * g) // nb
+        expect = np.bincount(np.clip(bins, 0, g - 1), minlength=g)
+        np.testing.assert_array_equal(mon._counts[u, :g], expect,
+                                      err_msg=rec["name"])
+
+
+def test_drift_vectorized_psi_matches_reference_psi():
+    """The monitor's vectorized PSI (_refresh_psi) and the standalone
+    psi() the math tests pin must stay the SAME formula — smoothing,
+    group count, empty-side rule."""
+    x, y = _data()
+    prof = _profile_of(x, y)
+    mon = DriftMonitor(prof, sample_rate=1.0, profile_bins=5)
+    rng = np.random.RandomState(9)
+    shifted = rng.rand(600, 4)
+    shifted[:, 1] = shifted[:, 1] ** 3      # reshaped, not just moved
+    mon.observe(shifted)
+    mon.flush()
+    for u in range(prof.num_features):
+        g = int(mon._g[u])
+        assert mon._psi[u] == pytest.approx(
+            psi(mon._base[u, :g], mon._counts[u, :g]), abs=1e-12)
+
+
+def test_drift_monitor_credit_sampling_converges():
+    """At a fractional sample rate the integer-credit draw sees the
+    requested fraction of rows (via credit conservation across
+    requests, taken in DRIFT_BURST_ROWS contiguous bursts)."""
+    from lightgbm_tpu.serving.drift import DRIFT_BURST_ROWS
+    x, y = _data()
+    prof = _profile_of(x, y)
+    mon = DriftMonitor(prof, sample_rate=0.01)
+    rng = np.random.RandomState(5)
+    for _ in range(50):
+        mon.observe(rng.rand(100, 4))
+    mon.flush()
+    assert mon.rows_seen == 5000
+    # 1% of 5000 = 50, taken in bursts of 8 -> 48 landed, 2 in credit
+    assert mon.rows_sampled == 50 - 50 % DRIFT_BURST_ROWS
+
+
+def test_drift_monitor_narrow_rows_are_missing():
+    """Rows narrower than the profiled width bin the absent feature
+    like NaN (-> the zero bin), not as a crash."""
+    x, y = _data()
+    prof = _profile_of(x, y)
+    mon = DriftMonitor(prof, sample_rate=1.0)
+    mon.observe(np.random.rand(300, 2))   # features 2,3 absent
+    assert mon.rows_sampled == 300
+
+
+# -------------------------------------------------------- skew monitor
+
+def test_skew_monitor_counts_divergence(tmp_path, capsys):
+    from lightgbm_tpu.utils.log import Log
+    x, y = _data()
+    b, _ = _train(x, y)
+    Log.reset_log_level(1)   # verbose=-1 training muted warnings
+    model_path = str(tmp_path / "model.txt")
+    b.save_model(model_path)
+    ref = host_reference_scorer(model_path)
+    rows = x[:64]
+    served = np.asarray(ref("predict", rows))
+    mon = SkewMonitor(ref, sample_rate=1.0, skew_warn=1,
+                      max_rows_per_check=64)
+    mon.observe(rows, served, "predict")
+    assert mon.skew_count == 0 and mon.rows_checked == 64
+    # a corrupted serving path is caught and warned about
+    mon.observe(rows, served + 0.01, "predict")
+    snap = mon.snapshot()
+    assert snap["skew_count"] == 64
+    assert snap["skew_max_abs_diff"] == pytest.approx(0.01, rel=1e-6)
+    assert "skew_warn" in capsys.readouterr().out
+    # leaf responses are skipped
+    mon.observe(rows, served + 1.0, "leaf")
+    assert mon.skew_count == 64
+
+
+def test_host_reference_scorer_ignores_device_env(tmp_path, monkeypatch):
+    """The skew reference must stay on the host f64 path even when the
+    deployment exports LIGHTGBM_TPU_DEVICE_PREDICT=force for its own
+    predictors."""
+    x, y = _data(n=500)
+    b, _ = _train(x, y)
+    model_path = str(tmp_path / "model.txt")
+    b.save_model(model_path)
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_PREDICT", "force")
+    ref = host_reference_scorer(model_path)
+    # the forced-host booster inside the closure routes host regardless
+    assert ref.__closure__ is not None
+    boosters = [c.cell_contents for c in ref.__closure__
+                if hasattr(c.cell_contents, "_use_device_predict")]
+    assert boosters and not boosters[0]._use_device_predict(10**6, 100)
+    out = np.asarray(ref("predict", x[:8]))
+    assert out.shape[0] == 8 and np.isfinite(out).all()
+
+
+# -------------------------------------------------------- e2e acceptance
+
+@pytest.fixture
+def served_model(tmp_path):
+    """Train -> save (model + profile sidecar) -> serve with drift and
+    skew monitors at full sampling."""
+    x, y = _data()
+    b, _ = _train(x, y)
+    model_path = str(tmp_path / "model.txt")
+    b.save_model(model_path)
+    profile = DatasetProfile.load(model_profile_path(model_path))
+    pred = CompiledPredictor.from_model_file(model_path,
+                                            max_batch_rows=256)
+    drift = DriftMonitor(profile, sample_rate=1.0, psi_warn=0.2,
+                         pred_range=(0.0, 1.0))
+    skew = SkewMonitor(host_reference_scorer(model_path),
+                       sample_rate=1.0, skew_warn=1)
+    from lightgbm_tpu.utils.log import Log
+    Log.reset_log_level(1)   # verbose=-1 training muted warnings
+    srv = make_server(pred, port=0, max_wait_ms=1.0,
+                      drift=drift, skew=skew)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        yield url, profile
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv.batcher.close()
+
+
+def _post(url, rows):
+    req = urllib.request.Request(
+        url + "/predict",
+        data=json.dumps({"rows": rows.tolist()}).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+def _get(url, path):
+    return json.loads(urllib.request.urlopen(url + path,
+                                             timeout=30).read())
+
+
+def test_drift_e2e_shifted_feature_trips_everything(served_model,
+                                                    capsys):
+    url, profile = served_model
+    rng = np.random.RandomState(11)
+
+    # phase 1: unshifted traffic stays quiet
+    for _ in range(6):
+        _post(url, rng.rand(100, 4))
+    dz = _get(url, "/driftz")
+    assert dz["enabled"]
+    assert dz["rows_sampled"] >= dz["min_psi_rows"]
+    assert dz["psi_max"] < 0.2
+    assert not dz["warnings"]
+    assert dz["skew"]["skew_count"] == 0
+    assert dz["skew"]["skew_rows_checked"] > 0
+    assert dz["prediction"]["count"] > 0
+
+    # phase 2: one feature's distribution deliberately shifts
+    name0 = profile.features[0]["name"]
+    for _ in range(6):
+        rows = rng.rand(100, 4)
+        rows[:, 0] += 3.0
+        _post(url, rows)
+    dz = _get(url, "/driftz")
+    assert dz["features"][name0]["psi"] >= 0.2
+    others = [f for f in dz["features"] if f != name0]
+    assert all(dz["features"][f]["psi"] < 0.2 for f in others)
+    assert [w["feature"] for w in dz["warnings"]] == [name0]
+
+    # /metricz: JSON gauges + Prometheus exposition
+    mz = _get(url, "/metricz")
+    assert mz["drift_psi_max"] >= 0.2
+    assert mz["drift_features_over_warn"] == 1
+    assert mz["skew_count"] == 0
+    prom = urllib.request.urlopen(url + "/metricz?format=prometheus",
+                                  timeout=30).read().decode()
+    assert "lightgbm_tpu_drift_psi_max" in prom
+    assert f"lightgbm_tpu_drift_psi_{name0}" in prom
+    assert "lightgbm_tpu_skew_count 0" in prom
+
+    # the structured warning log named the drifting feature
+    out = capsys.readouterr().out
+    assert "drift_warn" in out and f"feature={name0}" in out
+
+
+def test_serve_cli_flags_exist():
+    """The serve CLI grew the drift/skew flags (smoke: --help parses;
+    the full subprocess e2e lives in test_serving's CLI test)."""
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.serve", "--help"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0
+    for flag in ("--profile", "--drift-sample-rate", "--psi-warn",
+                 "--skew-sample-rate", "--skew-warn", "--profile-bins"):
+        assert flag in r.stdout

@@ -1,0 +1,367 @@
+"""Model-quality ledger suite (ISSUE 9, training side).
+
+- split/gain feature importance reproduces reference semantics (split
+  = count of splits per feature, gain = split_gain summed over them)
+  against a hand-rolled loop over the dumped trees;
+- the ledger agrees across learner paths: serial masked, fused scan,
+  per-iteration loop, out-of-core streaming (bit-identical split AND
+  gain vectors — those engines produce bit-identical trees), and the
+  data-parallel learner on the 8-device mesh (bit-identical split
+  counts; gain to the pair-allreduce's f32 reduction tolerance);
+- `quality_telemetry` journals schema-valid `quality` records whose
+  deltas sum back to the final ledger, on the fused AND per-iteration
+  paths, and keeps gauges/ledger consistent across rollback;
+- the Perfetto export renders `quality` records as counter tracks and
+  validate_trace accepts them (and rejects malformed counters).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import DatasetLoader
+from lightgbm_tpu.models.gbdt import create_boosting
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.telemetry import export
+from lightgbm_tpu.telemetry.journal import read_journal, validate_record
+from lightgbm_tpu.telemetry.quality import (QualityTracker, SplitLedger,
+                                            feature_importance_from_models,
+                                            tree_split_records)
+from lightgbm_tpu.utils.log import LightGBMError
+
+BASE = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+        "learning_rate": 0.1, "verbose": -1, "device_row_chunk": 256,
+        "hist_compaction": "false"}
+N_ROUNDS = 5
+
+
+def _data(n=3000, f=8, seed=3):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    y = (x[:, 0] + 0.6 * x[:, 1] * x[:, 2]
+         + 0.8 * rng.randn(n) > 0).astype(np.float64)
+    return x, y
+
+
+def _reference_importance(booster, n_features):
+    """The semantics under test, written the dumb way: loop every
+    tree, count/sum per split (gbdt.cpp:585-610 + the C API's gain
+    variant)."""
+    split = np.zeros(n_features, np.int64)
+    gain = np.zeros(n_features, np.float64)
+    for tree in booster.gbdt.models:
+        tree = (tree.materialize() if hasattr(tree, "materialize")
+                else tree)
+        for s in range(tree.num_leaves - 1):
+            split[tree.split_feature_real[s]] += 1
+            gain[tree.split_feature_real[s]] += tree.split_gain[s]
+    return split, gain
+
+
+# ------------------------------------------------------- reference parity
+
+def test_importance_reference_semantics():
+    x, y = _data()
+    b = lgb.train(dict(BASE), lgb.Dataset(x, y), num_boost_round=N_ROUNDS)
+    split, gain = _reference_importance(b, x.shape[1])
+    got_split = b.feature_importance("split")
+    got_gain = b.feature_importance("gain")
+    assert got_split.dtype == np.int64
+    assert got_gain.dtype == np.float64
+    np.testing.assert_array_equal(got_split, split)
+    np.testing.assert_array_equal(got_gain, gain)   # same floats, same order
+    assert got_split.sum() == sum(
+        t.num_leaves - 1 for t in b.gbdt.models)
+
+
+def test_importance_default_is_split():
+    x, y = _data(n=800)
+    b = lgb.train(dict(BASE), lgb.Dataset(x, y), num_boost_round=2)
+    np.testing.assert_array_equal(b.feature_importance(),
+                                  b.feature_importance("split"))
+
+
+def test_importance_unknown_type_raises():
+    x, y = _data(n=800)
+    b = lgb.train(dict(BASE), lgb.Dataset(x, y), num_boost_round=2)
+    with pytest.raises(LightGBMError):
+        b.feature_importance("shapley")
+
+
+def test_tree_split_records_fields():
+    x, y = _data(n=800)
+    b = lgb.train(dict(BASE), lgb.Dataset(x, y), num_boost_round=1)
+    tree = b.gbdt.models[0]
+    rec = tree_split_records(tree)
+    ns = tree.num_leaves - 1
+    for key in ("feature", "gain", "threshold", "decision_type",
+                "count", "left_child", "right_child"):
+        assert len(rec[key]) == ns
+    assert (rec["gain"] >= 0).all()
+    # the root split saw every row
+    assert rec["count"][0] == 800
+
+
+def test_model_file_importance_block_unchanged():
+    """The model text's "feature importances:" block still renders
+    from the (refactored) split ledger, sorted by count."""
+    x, y = _data(n=1200)
+    b = lgb.train(dict(BASE), lgb.Dataset(x, y), num_boost_round=3)
+    text = b.gbdt.save_model_to_string(-1)
+    block = text.split("feature importances:")[1].strip().splitlines()
+    counts = [int(line.split("=")[1]) for line in block if "=" in line]
+    assert counts == sorted(counts, reverse=True)
+    assert sum(counts) == int(b.feature_importance("split").sum())
+
+
+def test_sklearn_feature_importances_():
+    sklearn = pytest.importorskip("sklearn")  # noqa: F841
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    x, y = _data(n=1200)
+    est = LGBMClassifier(n_estimators=3, min_child_samples=10)
+    est.fit(x, y)
+    imp = est.feature_importances_
+    np.testing.assert_array_equal(
+        imp, est.booster().feature_importance("split"))
+    # the legacy normalized accessor stays consistent with it
+    np.testing.assert_allclose(est.feature_importance(),
+                               imp / imp.sum(), rtol=1e-6)
+
+
+# -------------------------------------------------- cross-learner ledger
+
+def _importances(booster_like, n_features):
+    models = booster_like.gbdt.models if hasattr(booster_like, "gbdt") \
+        else booster_like.models
+    return (feature_importance_from_models(models, n_features, "split"),
+            feature_importance_from_models(models, n_features, "gain"))
+
+
+def test_ledger_agreement_serial_fused_periter_ooc(tmp_path):
+    """The acceptance contract: trees pinned identical => importance
+    vectors BIT-identical. The masked serial engine, the fused scan,
+    the per-iteration loop and the out-of-core streaming learner all
+    produce bit-identical trees on the same binning."""
+    x, y = _data()
+    f = x.shape[1]
+    fused = lgb.train(dict(BASE), lgb.Dataset(x.copy(), y.copy()),
+                      num_boost_round=N_ROUNDS)
+    per_iter = lgb.Booster(params=dict(BASE),
+                           train_set=lgb.Dataset(x.copy(), y.copy()))
+    for _ in range(N_ROUNDS):
+        per_iter.update()
+    ooc_params = dict(BASE, out_of_core=True, block_rows=512,
+                      ooc_dir=str(tmp_path / "blocks"))
+    ooc = lgb.train(ooc_params,
+                    lgb.Dataset(x.copy(), y.copy(), params=ooc_params),
+                    num_boost_round=N_ROUNDS)
+    ref_split, ref_gain = _importances(fused, f)
+    assert ref_split.sum() > 0
+    for other in (per_iter, ooc):
+        o_split, o_gain = _importances(other, f)
+        np.testing.assert_array_equal(ref_split, o_split)
+        np.testing.assert_array_equal(ref_gain, o_gain)
+
+
+def test_ledger_agreement_data_parallel():
+    """Data-parallel on the 8-device mesh applies the same global best
+    split per node as serial (test_parallel pins tree structure):
+    split counts are bit-identical; gains agree to the histogram
+    pair-allreduce's f32 reduction-order tolerance."""
+    from sklearn import datasets
+    X, y = datasets.load_breast_cancer(return_X_y=True)
+
+    def _train(learner):
+        cfg = Config(objective="binary", num_leaves=15, learning_rate=0.1,
+                     min_data_in_leaf=10, tree_learner=learner,
+                     verbose=-1, device_row_chunk=256,
+                     hist_compaction="false")
+        ds = DatasetLoader(cfg).construct_from_matrix(X, label=y)
+        obj = create_objective(cfg.objective, cfg)
+        obj.init(ds.metadata, ds.num_data)
+        g = create_boosting(cfg.boosting_type)
+        g.init(cfg, ds, obj, [])
+        for _ in range(6):
+            if g.train_one_iter(is_eval=False):
+                break
+        return g
+
+    gs, gd = _train("serial"), _train("data")
+    n = gs.max_feature_idx + 1
+    np.testing.assert_array_equal(
+        feature_importance_from_models(gs.models, n, "split"),
+        feature_importance_from_models(gd.models, n, "split"))
+    np.testing.assert_allclose(
+        feature_importance_from_models(gs.models, n, "gain"),
+        feature_importance_from_models(gd.models, n, "gain"),
+        rtol=1e-6)
+
+
+# ------------------------------------------------------ quality telemetry
+
+def _quality_records(path):
+    records, bad = read_journal(path)
+    assert bad == 0
+    for rec in records:
+        assert not validate_record(rec), (rec, validate_record(rec))
+    return [r for r in records if r.get("event") == "quality"]
+
+
+def test_quality_records_fused_path(tmp_path):
+    x, y = _data()
+    params = dict(BASE, telemetry=True, telemetry_dir=str(tmp_path),
+                  quality_telemetry=True)
+    b = lgb.train(params, lgb.Dataset(x, y), num_boost_round=N_ROUNDS)
+    recs = _quality_records(b.gbdt.journal.path)
+    b.gbdt.close_telemetry()
+    assert recs, "fused path journaled no quality records"
+    assert sum(r["trees"] for r in recs) == len(b.gbdt.models)
+    assert sum(r["splits"] for r in recs) == int(
+        b.feature_importance("split").sum())
+    total_gain = sum(r["gain_total"] for r in recs)
+    assert total_gain == pytest.approx(
+        float(b.feature_importance("gain").sum()), rel=1e-9)
+    for r in recs:
+        assert r["leaf_values"]["min"] <= r["leaf_values"]["max"]
+        assert r["top_gain"]  # something split, so something ranked
+
+
+def test_quality_records_blockwise_with_metrics(tmp_path):
+    """The blockwise fused path (valid set + eval) journals quality
+    records per device block, carrying the latest eval values."""
+    x, y = _data()
+    xv, yv = _data(n=600, seed=11)
+    params = dict(BASE, telemetry=True, telemetry_dir=str(tmp_path),
+                  quality_telemetry=True, metric="binary_logloss")
+    train_set = lgb.Dataset(x, y)
+    # early_stopping caps the block size at 5, so 10 rounds = two
+    # blocks — the SECOND block's quality record carries the eval
+    # values the first block's replay produced (a block's record is
+    # written before its own evals replay)
+    b = lgb.train(params, train_set, num_boost_round=10,
+                  valid_sets=[train_set.create_valid(xv, yv)],
+                  early_stopping_rounds=5, verbose_eval=False)
+    recs = _quality_records(b.gbdt.journal.path)
+    b.gbdt.close_telemetry()
+    assert recs and sum(r["trees"] for r in recs) == len(b.gbdt.models)
+    valued = [r for r in recs if r.get("values")]
+    assert valued and any("logloss" in k
+                          for r in valued for k in r["values"])
+
+
+def test_quality_records_per_iteration_path(tmp_path):
+    """DART is fused-ineligible (host-side tree dropping), so it
+    exercises the TRUE per-iteration loop: one quality record per
+    iteration, LazyTrees materialized by the ledger."""
+    x, y = _data()
+    params = dict(BASE, boosting_type="dart", telemetry=True,
+                  telemetry_dir=str(tmp_path), quality_telemetry=True)
+    b = lgb.train(params, lgb.Dataset(x, y), num_boost_round=4)
+    recs = _quality_records(b.gbdt.journal.path)
+    b.gbdt.close_telemetry()
+    assert len(recs) == 4
+    assert sum(r["trees"] for r in recs) == len(b.gbdt.models)
+
+
+def test_quality_gauges_without_journal():
+    """quality_telemetry without `telemetry` still feeds the registry
+    gauges (the /trainz + Prometheus surface)."""
+    x, y = _data(n=1000)
+    b = lgb.train(dict(BASE, quality_telemetry=True), lgb.Dataset(x, y),
+                  num_boost_round=2)
+    gauges = b.gbdt.metrics.snapshot()["gauges"]
+    assert gauges["quality_trees_total"] == len(b.gbdt.models)
+    assert gauges["quality_splits_total"] == int(
+        b.feature_importance("split").sum())
+    assert gauges["quality_gain_total"] == pytest.approx(
+        float(b.feature_importance("gain").sum()), rel=1e-9)
+    assert b.gbdt.quality.snapshot()["top_features"]
+
+
+def test_quality_tracker_rollback_resyncs():
+    """A shrunk model list (rollback) rebuilds the ledger silently;
+    totals match the surviving trees."""
+    x, y = _data(n=1000)
+    b = lgb.Booster(params=dict(BASE, quality_telemetry=True),
+                    train_set=lgb.Dataset(x, y))
+    for _ in range(3):
+        b.update()
+    b.rollback_one_iter()
+    b.gbdt._journal_quality()
+    assert b.gbdt.quality.ledger.n_trees == len(b.gbdt.models) == 2
+    np.testing.assert_array_equal(
+        b.gbdt.quality.ledger.importance("split"),
+        b.feature_importance("split"))
+
+
+def test_quality_tracker_rollback_retrain_same_length_resyncs():
+    """rollback_one_iter + one retrained iteration restores the model
+    list LENGTH — the tracker must still notice (version counter /
+    rollback-site resync) and count the replacement tree, not the
+    rolled-back one."""
+    x, y = _data(n=1000)
+    b = lgb.Booster(params=dict(BASE, quality_telemetry=True),
+                    train_set=lgb.Dataset(x, y))
+    for _ in range(3):
+        b.update()
+    b.gbdt._journal_quality()
+    b.rollback_one_iter()
+    b.update()                     # back to 3 trees, different last tree
+    b.gbdt._journal_quality()
+    assert b.gbdt.quality.ledger.n_trees == len(b.gbdt.models) == 3
+    np.testing.assert_array_equal(
+        b.gbdt.quality.ledger.importance("split"),
+        b.feature_importance("split"))
+    np.testing.assert_array_equal(
+        b.gbdt.quality.ledger.importance("gain"),
+        b.feature_importance("gain"))
+
+
+def test_split_ledger_incremental_equals_batch():
+    x, y = _data(n=1000)
+    b = lgb.train(dict(BASE), lgb.Dataset(x, y), num_boost_round=3)
+    incremental = SplitLedger(x.shape[1])
+    for tree in b.gbdt.models:
+        incremental.add_tree(tree)
+    np.testing.assert_array_equal(
+        incremental.importance("gain"),
+        feature_importance_from_models(b.gbdt.models, x.shape[1], "gain"))
+    tracker = QualityTracker(x.shape[1])
+    delta = tracker.sync(list(b.gbdt.models))
+    assert delta["trees"] == 3
+    assert delta["importance_shift"] > 0
+    assert tracker.sync(list(b.gbdt.models)) is None   # nothing new
+
+
+# -------------------------------------------------------- trace export
+
+def test_quality_counter_track_in_trace(tmp_path):
+    x, y = _data()
+    params = dict(BASE, telemetry=True, telemetry_dir=str(tmp_path),
+                  quality_telemetry=True)
+    b = lgb.train(params, lgb.Dataset(x, y), num_boost_round=3)
+    # a serving-side drift summary can land in the same timeline
+    b.gbdt.journal.event("quality", iteration=int(b.gbdt.iter),
+                         psi_max=0.42, skew_count=0)
+    b.gbdt.close_telemetry()
+    trace, _ = export.export_trace(str(tmp_path))
+    assert not export.validate_trace(trace)
+    counters = [e for e in trace["traceEvents"]
+                if e.get("ph") == "C" and e.get("name") == "quality"]
+    assert counters, "no quality counter track in the export"
+    keys = set().union(*(e["args"].keys() for e in counters))
+    assert "gain_total" in keys
+    assert "psi_max" in keys and "skew_count" in keys
+
+
+def test_validate_trace_rejects_malformed_counter():
+    bad = {"traceEvents": [
+        {"name": "quality", "ph": "C", "ts": 1, "pid": 0, "tid": 0,
+         "args": {}},
+        {"name": "quality", "ph": "C", "ts": 1, "pid": 0, "tid": 0,
+         "args": {"gain_total": "high"}},
+    ]}
+    errors = export.validate_trace(bad)
+    assert any("non-empty args" in e for e in errors)
+    assert any("must be numeric" in e for e in errors)

@@ -73,11 +73,12 @@ def expand(paths):
 
 
 def run_demo():
-    """Train 3 iterations with telemetry (and the span-ring dump) on,
-    lint the journal — proving the writer honors the schema end to end,
-    including the memory/compile/spans introspection records — then
-    round-trip it through the trace exporter: export -> json.load ->
-    event invariants (the `make verify-obs` acceptance path)."""
+    """Train 3 iterations with telemetry (the span-ring dump AND
+    quality telemetry) on, lint the journal — proving the writer honors
+    the schema end to end, including the memory/compile/spans/quality
+    records — then round-trip it through the trace exporter:
+    export -> json.load -> event invariants (the `make verify-obs`
+    acceptance path)."""
     import json as json_mod
     import shutil
     import tempfile
@@ -95,7 +96,8 @@ def run_demo():
         booster = lgb.train({"objective": "binary", "num_leaves": 7,
                              "min_data_in_leaf": 10, "verbose": 0,
                              "telemetry": True, "telemetry_dir": d,
-                             "telemetry_trace": True},
+                             "telemetry_trace": True,
+                             "quality_telemetry": True},
                             lgb.Dataset(x, y), num_boost_round=3)
         # end the run the way a finishing process does: the close drains
         # the final introspection records + the span-ring dump
@@ -106,7 +108,7 @@ def run_demo():
             return rc
         events = {rec.get("event")
                   for rec in export.collect_records(d)[0]}
-        for required in ("memory", "spans"):
+        for required in ("memory", "spans", "quality"):
             if required not in events:
                 print(f"demo journal: no `{required}` record — the "
                       "introspection drain is broken")

@@ -76,7 +76,8 @@ def check_speed():
         print(f"verify-perf: hist effective bandwidth "
               f"{res['phases']['hist_bytes_per_s'] / 1e9:.2f} GB/s")
     ok_mem = check_memory(base, res)
-    return ok_speed and ok_auc and ok_mem
+    ok_quality = check_quality_overhead(res)
+    return ok_speed and ok_auc and ok_mem and ok_quality
 
 
 def check_memory(base, res):
@@ -108,6 +109,32 @@ def check_memory(base, res):
     print(f"verify-perf: peak memory {peak / 1e6:.0f} MB vs baseline "
           f"{base_peak / 1e6:.0f} MB (limit {limit / 1e6:.0f} MB) -> "
           f"{'OK' if ok else 'REGRESSION'}")
+    return ok
+
+
+QUALITY_TOL_PCT = float(os.environ.get("VERIFY_QUALITY_TOL_PCT", "1.0"))
+
+
+def check_quality_overhead(res):
+    """Model-quality observability bar (bench quality_probe): the
+    split-ledger pass must cost <1% of train time on the CPU rung and
+    the drift+skew monitors (default sample rates) <1% of serving
+    time. A missing measurement fails — the bar only means something
+    if it is actually measured."""
+    ok = True
+    for key, what in (("quality_train_overhead_pct", "train rung"),
+                      ("quality_serving_overhead_pct", "serving probe")):
+        val = res["phases"].get(key)
+        if val is None:
+            print(f"verify-perf: {key} missing from bench phases "
+                  "-> quality probe did not run")
+            ok = False
+            continue
+        good = val < QUALITY_TOL_PCT
+        print(f"verify-perf: quality monitor overhead {val:.4f}% of "
+              f"{what} (bar {QUALITY_TOL_PCT:.1f}%) -> "
+              f"{'OK' if good else 'OVER BUDGET'}")
+        ok = ok and good
     return ok
 
 
