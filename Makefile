@@ -38,6 +38,14 @@ verify-dist:
 	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
 	  tests/test_supervisor.py tests/test_distributed.py -q
 
+# distributed comms guard (bench dist_probe via tools/verify_perf.py
+# --dist): the 2-process gloo CPU data-parallel rung's per-tree
+# collective wire bytes must stay within 15% of the committed
+# BENCH_BASELINE.json dist_collective_bytes_per_tree AND >=3x below
+# the legacy allgather-pair exchange measured side by side
+verify-dist-perf:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --dist
+
 # online-inference suite: CompiledPredictor parity across objectives,
 # NaN categorical routing, micro-batcher coalescing, streaming
 # predict_file, and the end-to-end `python -m lightgbm_tpu.serve`
@@ -92,5 +100,5 @@ verify-ooc:
 clean:
 	rm -f $(TARGET)
 
-.PHONY: all test-capi verify-fault verify-dist verify-serve verify-obs \
-	verify-perf verify-quality verify-ooc clean
+.PHONY: all test-capi verify-fault verify-dist verify-dist-perf \
+	verify-serve verify-obs verify-perf verify-quality verify-ooc clean

@@ -954,6 +954,199 @@ def ooc_probe(timeout_s=600):
     return out
 
 
+def run_dist_child():
+    """Distributed-probe worker (`bench.py --dist-child`): one rank of
+    a 2-process gloo CPU data-parallel job (2 virtual devices per
+    process — the verify-dist harness shape, so the mesh is 4 shards
+    wide), or the single-process serial baseline when
+    BENCH_DIST_SERIAL=1. Trains the shared CSV, then prints one
+    ``DIST_CHILD {json}`` line with the timed-window train seconds and
+    the collective-byte / sync-wait counters (parallel/mesh.py CommPlan
+    -> MetricsRegistry)."""
+    serial = bool(os.environ.get("BENCH_DIST_SERIAL"))
+    rank = 0 if serial else int(os.environ["BENCH_DIST_RANK"])
+    iters = int(os.environ.get("BENCH_DIST_ITERS", "8"))
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import DatasetLoader
+    from lightgbm_tpu.models.gbdt import GBDT
+    from lightgbm_tpu.objectives import create_objective
+    from lightgbm_tpu.parallel import heartbeat
+    from lightgbm_tpu.parallel.distributed import init_from_config
+
+    params = {
+        "objective": "binary", "num_leaves": 31, "num_iterations": iters,
+        "min_data_in_leaf": 20, "metric_freq": 0, "verbose": -1,
+        "enable_load_from_binary_file": False,
+    }
+    if serial:
+        params["tree_learner"] = "serial"
+    else:
+        params.update({
+            "tree_learner": "data", "num_machines": 2,
+            "machine_list_file": os.environ["BENCH_DIST_MLIST"],
+            "hist_exchange": os.environ.get("BENCH_DIST_EXCHANGE", "auto"),
+            "comm_precision": os.environ.get("BENCH_DIST_PRECISION",
+                                             "pair"),
+            # arming the watchdog makes every collective-guarded sync
+            # point measure its wait (sync_wait_s) — and bounds a hung
+            # peer instead of wedging the probe
+            "collective_timeout_s": 300,
+        })
+    cfg = Config.from_params(params)
+    if not serial:
+        init_from_config(cfg)
+        # arm the watchdog (the CLI does this in application.py): armed
+        # sync points are what measure sync_wait_s
+        heartbeat.configure(cfg, "", rank, 2)
+    import jax
+    ds = DatasetLoader(cfg).load_from_file(
+        os.environ["BENCH_DIST_DATA"],
+        rank=0 if serial else jax.process_index(),
+        num_machines=1 if serial else 2)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    booster = GBDT()
+    booster.init(cfg, ds, obj, [])
+    heartbeat.bind_timing_sink(
+        lambda name, s: booster.metrics.observe("sync_wait_s", s))
+
+    def comm_counters():
+        snap = booster.metrics.snapshot()
+        return ({k: v for k, v in snap["counters"].items()
+                 if k.startswith("collective_bytes")},
+                snap["histograms"].get("sync_wait_s", {}).get("total", 0.0))
+
+    booster.train_one_iter(is_eval=False)    # compile outside the window
+    c0, sync0 = comm_counters()
+    trees0 = len(booster.models)
+    t0 = time.time()
+    for _ in range(iters):
+        booster.train_one_iter(is_eval=False)
+    train_s = time.time() - t0
+    c1, sync1 = comm_counters()
+    trees = len(booster.models) - trees0
+    res = {
+        "rank": rank, "serial": serial,
+        "rows": int(getattr(ds, "global_num_data", None) or ds.num_data),
+        "iters": iters, "trees": trees,
+        "train_s": round(train_s, 3),
+        "sync_wait_s": round(sync1 - sync0, 4),
+        "collective_bytes": {k: int(c1[k] - c0.get(k, 0)) for k in c1},
+    }
+    print("DIST_CHILD " + json.dumps(res), flush=True)
+
+
+def dist_probe(timeout_s=600):
+    """Distributed comms probe (`bench.py dist_probe`): a 2-process
+    gloo CPU data-parallel run on the verify-dist harness shape,
+    measuring per-tree collective wire bytes under the DEFAULT
+    reduce-scatter exchange vs the legacy allgather-pair, plus rows/s
+    against a single-process serial baseline. Emits the `dist.*`
+    numbers tools/verify_perf.py --dist gates against
+    BENCH_BASELINE.json (dist_collective_bytes_per_tree)."""
+    import socket
+    import tempfile
+
+    rows = int(os.environ.get("BENCH_DIST_ROWS", "40000"))
+    iters = int(os.environ.get("BENCH_DIST_ITERS", "8"))
+    d = tempfile.mkdtemp(prefix="bench_dist_")
+    out = {"rows": rows, "iters": iters}
+    try:
+        _mark(f"dist probe: writing {rows}-row CSV")
+        x, y = make_data(rows)
+        csv = os.path.join(d, "tr.csv")
+        np.savetxt(csv, np.column_stack([y, x]), delimiter=",",
+                   fmt="%.6f")
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        def spawn(rank, env_extra):
+            env = dict(os.environ)
+            env.update({"JAX_PLATFORMS": "cpu",
+                        "PALLAS_AXON_POOL_IPS": "",
+                        "BENCH_DIST_DATA": csv,
+                        "BENCH_DIST_ITERS": str(iters)})
+            env.update(env_extra)
+            return subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--dist-child"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        def parse(proc, what):
+            try:
+                out_text, _ = proc.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise RuntimeError(f"dist child ({what}) timed out")
+            for line in out_text.splitlines():
+                if line.startswith("DIST_CHILD "):
+                    return json.loads(line.split(" ", 1)[1])
+            raise RuntimeError(f"dist child ({what}) produced no result "
+                               f"(rc={proc.returncode}): "
+                               f"{out_text[-300:]}")
+
+        def run_pair(exchange):
+            port = free_port()
+            mlist = os.path.join(d, f"mlist_{exchange}.txt")
+            with open(mlist, "w") as f:
+                f.write(f"127.0.0.1 {port}\n127.0.0.1 {port + 1}\n")
+            procs = [spawn(rank, {
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "LIGHTGBM_TPU_RANK": str(rank),
+                "BENCH_DIST_RANK": str(rank),
+                "BENCH_DIST_MLIST": mlist,
+                "BENCH_DIST_EXCHANGE": exchange,
+            }) for rank in range(2)]
+            results = [parse(p, f"{exchange} rank{r}")
+                       for r, p in enumerate(procs)]
+            return results[0]
+
+        _mark("dist probe: 2-process reduce-scatter run")
+        rs = run_pair("auto")
+        _mark("dist probe: 2-process allgather run")
+        ag = run_pair("allgather")
+        _mark("dist probe: single-process serial baseline")
+        serial = parse(spawn(0, {"BENCH_DIST_SERIAL": "1"}), "serial")
+
+        def per_tree(res):
+            total = sum(res["collective_bytes"].get(
+                f"collective_bytes_{k}", 0)
+                for k in ("hist_reduce", "split_gather", "leaf_sync"))
+            return total / max(res["trees"], 1)
+
+        rs_bpt, ag_bpt = per_tree(rs), per_tree(ag)
+        rows_s = rows * iters / max(rs["train_s"], 1e-9)
+        serial_rows_s = rows * iters / max(serial["train_s"], 1e-9)
+        out.update({
+            "trees": rs["trees"],
+            "collective_bytes_per_tree": round(rs_bpt, 1),
+            "allgather_bytes_per_tree": round(ag_bpt, 1),
+            "bytes_reduction_vs_allgather": round(
+                ag_bpt / max(rs_bpt, 1e-9), 2),
+            "collective_bytes": rs["collective_bytes"],
+            "sync_wait_s": rs["sync_wait_s"],
+            "train_s": rs["train_s"],
+            "rows_s": round(rows_s, 1),
+            "serial_rows_s": round(serial_rows_s, 1),
+            "rows_s_vs_serial": round(rows_s / max(serial_rows_s, 1e-9),
+                                      3),
+        })
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"dist probe failed: {e}")
+        out["error"] = str(e)[-250:]
+    finally:
+        import shutil
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def run_child():
     """Child mode: one isolated measurement. Env: BENCH_CHILD_ROWS,
     optional BENCH_CHILD_CPU / LIGHTGBM_TPU_DISABLE_PALLAS /
@@ -1268,6 +1461,13 @@ def _format_result(res, reason):
 def main():
     if "--ooc-child" in sys.argv:
         run_ooc_child()
+        return
+    if "--dist-child" in sys.argv:
+        run_dist_child()
+        return
+    if "dist_probe" in sys.argv:
+        # standalone comms probe: `python bench.py dist_probe`
+        print(json.dumps({"dist": dist_probe()}), flush=True)
         return
     if "--child" in sys.argv:
         run_child()

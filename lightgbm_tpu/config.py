@@ -230,6 +230,23 @@ class Config:
     # the per-attempt timeout is `time_out` seconds
     init_retries: int = 3
     init_backoff_s: float = 1.0
+    # --- mesh communication (parallel/mesh.py; the reference's analog
+    # is the hand-rolled collective selection in src/network/) ---
+    # precision of histogram payloads AT THE COLLECTIVE BOUNDARY only
+    # (on-device arithmetic stays f32): "pair" exchanges both Kahan
+    # words (the serial==data-parallel bit-parity default), "f32" the
+    # collapsed word (half the bytes, deterministic), "bf16" quarter
+    # the bytes (lossy; AUC-tolerance territory)
+    comm_precision: str = "pair"
+    # data-parallel histogram exchange: "auto" = reduce-scatter (each
+    # rank reduces + searches only its owned feature block; ~W x fewer
+    # wire bytes), "reduce_scatter" forces it, "allgather" restores the
+    # full-histogram pair allgather (and is what bundled datasets use)
+    hist_exchange: str = "auto"
+    # feature-shard groups the reduce-scatter exchange is split into:
+    # group g+1's collective can be in flight while group g's split
+    # search runs (compute/comms overlap); 1 disables grouping
+    comm_groups: int = 2
 
     # --- distributed supervisor (parallel/heartbeat.py, supervisor.py;
     # no reference equivalent) ---
@@ -526,6 +543,12 @@ class Config:
         check(self.snapshot_freq >= 0, "snapshot_freq should be >= 0")
         check(self.snapshot_keep >= 1, "snapshot_keep should be >= 1")
         check(self.init_retries >= 0, "init_retries should be >= 0")
+        check(str(self.comm_precision).lower() in ("pair", "f32", "bf16"),
+              "comm_precision must be pair|f32|bf16")
+        check(str(self.hist_exchange).lower() in
+              ("auto", "reduce_scatter", "allgather"),
+              "hist_exchange must be auto|reduce_scatter|allgather")
+        check(self.comm_groups >= 1, "comm_groups should be >= 1")
         check(self.heartbeat_timeout_s >= 0,
               "heartbeat_timeout_s should be >= 0")
         check(self.collective_timeout_s >= 0,

@@ -768,6 +768,15 @@ class GBDT:
             with self.tracer.phase("host_sync"), \
                     heartbeat.collective_guard("leaf_count_sync"):
                 stopped = tree.num_leaves <= 1  # scalar sync: the only wait
+            # collective-byte ledger: the meshed learners' wire plan is
+            # root + per-split x n_splits (parallel/mesh.py CommPlan);
+            # n_splits is on host from the sync above, so the counters
+            # advance exactly once per tree — including 0-split trees,
+            # whose root exchange still moved bytes
+            account = getattr(self.tree_learner,
+                              "account_tree_collectives", None)
+            if account is not None:
+                account(tree.num_leaves - 1)
             if stopped:
                 Log.info("Stopped training because there are no more leafs "
                          "that meet the split requirements.")

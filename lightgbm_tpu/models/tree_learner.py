@@ -201,12 +201,17 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
       num_bin_pf: (F,) int32 bins per feature; is_cat: (F,) bool.
       num_leaves/max_bin/params/max_depth/row_chunk: static config.
       hist_psum_fn: takes the compensated (hist, residual) pair from
-        masked_histograms and returns the reduced+collapsed (F, B, 3)
-        histogram. Default: collapse only (single device / feature-
-        sharded learner); the data-parallel learner reduces shard pairs
-        in a FIXED order so every shard (and the serial learner) sees
+        masked_histograms and returns the reduced+collapsed histogram.
+        Default: collapse only (single device / feature-sharded
+        learner); the data-parallel learner reduces shard pairs in a
+        FIXED order so every shard (and the serial learner) sees
         histograms equal to ~f64 accuracy — the reference gets the same
-        guarantee from f64 accumulators (bin.h:18-26).
+        guarantee from f64 accumulators (bin.h:18-26). The reduction
+        may RETURN FEWER FEATURES than it was fed: the reduce-scatter
+        exchange (parallel/mesh.py) hands each shard only its owned
+        (f_loc, B, 3) block, and the histogram cache, subtraction trick
+        and evaluate_fn all operate in that owned space (the builder
+        sizes them from the reduced root histogram, not from `bins`).
       sum_psum_fn: reduces scalar root sums across row shards. Root
         sums are derived FROM the reduced histogram (any feature's bins
         partition the rows), so learners whose hist_psum_fn already
@@ -324,9 +329,13 @@ def build_tree_device(bins, grad, hess, inbag, feature_mask,
 
     state = init_split_state(l, root_split, root_c)
     state["row_leaf"] = row_leaf0
+    # feature count of the REDUCED histogram space: equals f except
+    # under a scattering hist_psum_fn (reduce-scatter hands each shard
+    # its owned f_loc block; cache/subtraction stay in owned space)
+    f_hist = hist_root.shape[0]
     if cache_hists:
         # per-leaf histogram cache (HistogramPool, fixed buffer)
-        state["hist_cache"] = (jnp.zeros((l, f, b, 3), dtype=f32)
+        state["hist_cache"] = (jnp.zeros((l, f_hist, b, 3), dtype=f32)
                                .at[0].set(hist_root))
 
     def body(i, st):

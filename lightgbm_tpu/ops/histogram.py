@@ -159,7 +159,7 @@ def callbacks_disabled():
     worker threads park on the GIL it holds — observed as a hang in
     the data-parallel compacted build, single-device programs are
     unaffected), so the meshed learners trace their builders under
-    this guard (parallel/learners.py _MeshedTreeLearner)."""
+    this guard (parallel/mesh.py meshed_trace_guard)."""
     depth = getattr(_NO_CALLBACKS, "depth", 0)
     _NO_CALLBACKS.depth = depth + 1
     try:
@@ -564,10 +564,10 @@ def compacted_histograms(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
       row_chunk: static scan chunk of the compacted buffer.
 
     Returns the compensated (value, residual) pair of
-    build_histograms_pair — collapse with `hi + lo`, or reduce shard
-    pairs in fixed order first (parallel/learners.py pair_allreduce;
-    the lax.switch holds no collectives, so shards on different buckets
-    still meet the reduction in lockstep).
+    build_histograms_pair — collapse with `hi + lo`, or exchange shard
+    pairs in fixed order first (parallel/mesh.py pair_allreduce /
+    pair_reduce_scatter; the lax.switch holds no collectives, so shards
+    on different buckets still meet the reduction in lockstep).
     """
     from .partition import compact_gather_indices
     f, n = bins.shape

@@ -211,8 +211,9 @@ def masked_histograms_xla(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
 def masked_histograms(bins, ghc_t, row_leaf, leaf_id, num_bins_total,
                       row_chunk=HIST_CHUNK):
     """Backend dispatch, resolved at trace time. Returns (hist, residual):
-    collapse with `hist + residual`, or reduce the pair across shards in
-    a fixed order first (parallel/learners.py pair_allreduce).
+    collapse with `hist + residual`, or exchange the pair across shards
+    in a fixed order first (parallel/mesh.py pair_allreduce /
+    pair_reduce_scatter).
 
     hist_mode=einsum/segment/bincount (or LIGHTGBM_TPU_DISABLE_PALLAS=1)
     forces the XLA path on TPU (escape hatch for kernel regressions;

@@ -54,12 +54,18 @@ def _call_initialize(coordinator, num_processes, rank, timeout_s):
 
 
 def _initialize_with_retry(coordinator, num_processes, rank, retries=3,
-                           backoff_s=1.0, timeout_s=120):
+                           backoff_s=1.0, timeout_s=120,
+                           collectives="default"):
     """jax.distributed.initialize with a per-attempt timeout and
     exponential-backoff retries (TPU fleets routinely restart the
     coordinator pod first; a transient connect failure must not kill
-    every worker). Returns True on success, False when the backend was
-    already initialized externally; fatal when retries are exhausted."""
+    every worker). Every structured log line names the chosen
+    collectives implementation (gloo vs the backend default) — the
+    first thing to check when a multi-host bring-up fails is whether
+    the CPU client even HAS cross-process collectives, and the journal
+    must answer that without shell access to the dead host. Returns
+    True on success, False when the backend was already initialized
+    externally; fatal when retries are exhausted."""
     delay = max(0.0, float(backoff_s))
     last_error = None
     for attempt in range(int(retries) + 1):
@@ -67,7 +73,8 @@ def _initialize_with_retry(coordinator, num_processes, rank, retries=3,
             _call_initialize(coordinator, num_processes, rank, timeout_s)
             if attempt:
                 Log.info("jax.distributed.initialize succeeded on "
-                         "attempt %d", attempt + 1)
+                         "attempt %d (collectives=%s)", attempt + 1,
+                         collectives)
             return True
         except RuntimeError as e:
             msg = str(e)
@@ -77,19 +84,23 @@ def _initialize_with_retry(coordinator, num_processes, rank, retries=3,
                     or "only be called once" in msg.lower()):
                 # backend already up (e.g. an external launcher
                 # initialized distributed itself) — keep going with it
-                Log.warning("jax.distributed.initialize skipped: %s", msg)
+                Log.warning("jax.distributed.initialize skipped "
+                            "(collectives=%s): %s", collectives, msg)
                 return False
             last_error = msg
         if attempt < retries:
             Log.warning("jax.distributed.initialize failed (attempt "
-                        "%d/%d): %s; retrying in %.1fs", attempt + 1,
-                        retries + 1, last_error, delay)
+                        "%d/%d, coordinator %s, rank %d of %d, "
+                        "collectives=%s): %s; retrying in %.1fs",
+                        attempt + 1, retries + 1, coordinator, rank,
+                        num_processes, collectives, last_error, delay)
             if delay > 0:
                 time.sleep(delay)
             delay = min(delay * 2 if delay > 0 else 1.0, 30.0)
     Log.fatal("jax.distributed.initialize failed after %d attempts "
-              "(coordinator %s, rank %d of %d): %s", retries + 1,
-              coordinator, rank, num_processes, last_error)
+              "(coordinator %s, rank %d of %d, collectives=%s): %s",
+              retries + 1, coordinator, rank, num_processes, collectives,
+              last_error)
 
 
 def init_from_config(config):
@@ -141,8 +152,10 @@ def init_from_config(config):
     # this jax and is what the 2-process CPU test harness runs on. A
     # TPU backend ignores the knob; absent knob (API drift) means CPU
     # multi-host was unsupported anyway, so best-effort is correct.
+    collectives = "default"
     try:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        collectives = "gloo"
     except Exception:
         pass
     # NOTE: must run before anything initializes the XLA backend —
@@ -151,11 +164,13 @@ def init_from_config(config):
                                   retries=getattr(config, "init_retries", 3),
                                   backoff_s=getattr(config, "init_backoff_s",
                                                     1.0),
-                                  timeout_s=getattr(config, "time_out", 120)):
+                                  timeout_s=getattr(config, "time_out", 120),
+                                  collectives=collectives):
         return False
     _initialized = True
-    Log.info("Distributed: rank %d of %d (coordinator %s), %d global devices",
-             rank, config.num_machines, coordinator, len(jax.devices()))
+    Log.info("Distributed: rank %d of %d (coordinator %s), %d global "
+             "devices, collectives=%s", rank, config.num_machines,
+             coordinator, len(jax.devices()), collectives)
     return True
 
 

@@ -5,19 +5,26 @@ implementations (feature_parallel_tree_learner.cpp,
 data_parallel_tree_learner.cpp, voting_parallel_tree_learner.cpp).
 The reference's hand-written collectives (Bruck allgather +
 recursive-halving reduce-scatter over TCP/MPI, src/network/) are
-replaced by XLA collectives over ICI/DCN; topology is XLA's problem.
+replaced by XLA collectives over ICI/DCN, injected through ONE shared
+mesh/communication layer (parallel/mesh.py) that owns the topology,
+the exchange algorithms, the `comm_precision` compression, and the
+per-collective wire-byte ledger.
 
 All three learners reuse the SAME jitted tree builder
-(models/tree_learner.py) under `jax.shard_map`, injecting collectives
-at exactly the reference's sync points:
+(models/tree_learner.py) under `shard_map`, with collectives at
+exactly the reference's sync points:
 
 - **Data parallel** (data_parallel_tree_learner.cpp): rows sharded.
-  `hist_psum_fn`/`sum_psum_fn` = `lax.psum` — the analog of the
-  reference's histogram ReduceScatter (:155-157) and root-sum Allreduce
-  (:97-124). Every shard then applies the identical global best split
-  (the invariant the reference maintains structurally); global leaf
-  counts come from the count column of the reduced histogram
-  (global_data_count_in_leaf_, :58-64).
+  Default exchange is the reference's REDUCE-SCATTER design (:155-157):
+  each rank reduce-scatters the smaller child's histogram pair so it
+  reduces (fixed-order Kahan) and split-searches only its OWNED feature
+  block, and the global best is an allgather+argmax of one tiny
+  SplitInfo per rank (:58-64 global counts ride in the SplitInfo). The
+  parent−sibling subtraction happens per rank on the owned block of
+  the reduced histogram cache — the cross-rank subtraction trick: only
+  the smaller child is ever exchanged. `hist_exchange=allgather`
+  restores the full-histogram pair allgather (every rank reduces and
+  searches everything).
 
 - **Feature parallel** (feature_parallel_tree_learner.cpp): features
   sharded, all rows on every device. Each shard evaluates splits on its
@@ -31,7 +38,8 @@ at exactly the reference's sync points:
   sharded, histograms kept LOCAL (hist_psum = identity); the evaluate
   hook votes on local top-k gains, all_gathers the candidate ids, and
   only the winning <=2k features' histograms are psum'd — the analog of
-  the selective ReduceScatter (:226-293).
+  the selective ReduceScatter (:226-293) — through the comm layer, so
+  `comm_precision` compression and byte accounting apply there too.
 """
 
 import functools
@@ -39,79 +47,30 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..models.tree_learner import SerialTreeLearner, build_tree_device
 from ..ops.split import (K_MIN_SCORE, find_best_split, per_feature_best,
                          split_info_at)
 from ..utils.log import Log
 from .heartbeat import collective_guard
-
-AXIS = "data"
-
-# shard_map across jax versions: new jax exports jax.shard_map with the
-# `check_vma` knob; older releases (<= 0.4.x, this image's pinned
-# toolchain) ship jax.experimental.shard_map with `check_rep` instead.
-# Same semantics for our use — both knobs only disable the replication-
-# consistency checker.
-if hasattr(jax, "shard_map"):
-    def shard_map(fn, mesh, in_specs, out_specs):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
-else:
-    from jax.experimental.shard_map import shard_map as _exp_shard_map
-
-    def shard_map(fn, mesh, in_specs, out_specs):
-        return _exp_shard_map(fn, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=False)
-
-
-def pair_allreduce(pair, axis_name=AXIS):
-    """Deterministic cross-shard histogram reduction: all_gather both
-    components of the compensated (value, residual) pair, then Kahan-sum
-    the 2K components in a FIXED order identical on every shard. This is
-    the collective analog of the reference's f64 histogram Allreduce
-    (data_parallel_tree_learner.cpp:155-157 with bin.h:18-26 f64
-    accumulators): shard count and reduction topology cannot perturb the
-    result beyond ~1e-14 relative, so every rank applies the identical
-    best split."""
-    hi, lo = pair
-    ghi = jax.lax.all_gather(hi, axis_name)          # (K, F, B, 3)
-    glo = jax.lax.all_gather(lo, axis_name)
-    comps = jnp.concatenate([ghi, glo], axis=0)      # fixed order
-
-    def kstep(carry, x):
-        s, c = carry
-        y = x - c
-        t = s + y
-        return (t, (t - s) - y), None
-
-    zero = jnp.zeros_like(hi)
-    (s, c), _ = jax.lax.scan(kstep, (zero, zero), comps)
-    return s - c
-
-
-def make_mesh(config) -> Mesh:
-    """1-D device mesh.
-
-    Multi-host (jax.distributed initialized, parallel/distributed.py):
-    span ALL global devices — `num_machines` already chose the process
-    count. Single-process: num_machines>1 limits the device count so
-    tests can model the reference's `num_machines` param; default: all
-    local devices."""
-    devs = jax.devices()
-    n = len(devs)
-    if (jax.process_count() == 1 and config is not None
-            and getattr(config, "num_machines", 1) > 1):
-        n = min(config.num_machines, len(devs))
-    return Mesh(np.asarray(devs[:n]), (AXIS,))
-
+# the mesh/topology/communication layer (one shim + one byte model for
+# every mesh user); AXIS/shard_map/make_mesh/pair_allreduce re-exported
+# here for existing import paths
+from .mesh import (AXIS, COLLECTIVE_KINDS, CommPlan,  # noqa: F401
+                   MeshTopology, allgather_recv_bytes, alltoall_recv_bytes,
+                   compressed_allreduce, compressed_psum,
+                   compressed_reduce_scatter, make_mesh, meshed_trace_guard,
+                   pair_allreduce, pair_reduce_scatter, psum_recv_bytes,
+                   resolve_hist_exchange, shard_map)
 
 _TREE_OUT_KEYS = (
     "n_splits", "row_leaf", "split_feature", "split_threshold_bin",
     "split_gain", "left_child", "right_child", "leaf_parent", "leaf_value",
     "leaf_count", "internal_value", "internal_count",
 )
+
+_SPLIT_INFO_BYTES = 11 * 4   # SplitInfo: 11 scalar fields on the wire
 
 
 class _MeshedTreeLearner(SerialTreeLearner):
@@ -136,7 +95,7 @@ class _MeshedTreeLearner(SerialTreeLearner):
         # leaf-contiguous builder): the north-star data-parallel config
         # must hit the fast core with no flag. The reference's EXACT
         # serial == parallel tree guarantee remains available under
-        # partitioned_build=false (masked + Kahan pair-allreduce); the
+        # partitioned_build=false (masked + Kahan pair exchange); the
         # partitioned parity serial==parallel is pinned to f32
         # summation-order ulps by test_parallel.py.
         return super()._partitioned_enabled(cfg)
@@ -161,8 +120,12 @@ class _MeshedTreeLearner(SerialTreeLearner):
 
     def init(self, train_set):
         self.mesh = make_mesh(self.config)
+        self.topology = MeshTopology(self.mesh, self.config)
         self.n_shards = self.mesh.devices.size
         self.n_proc = jax.process_count()
+        self._comm_plan = CommPlan()
+        self._journal_prev_comm = None
+        self._mesh_journaled = False
         # per-rank loading records the global row count and the largest
         # per-rank block (identical pad lengths on every rank require it)
         self.global_num_data = getattr(train_set, "global_num_data", None) \
@@ -171,6 +134,16 @@ class _MeshedTreeLearner(SerialTreeLearner):
         super().init(train_set)
         Log.info("%s tree learner on %d devices (%d processes)",
                  self.name, self.n_shards, self.n_proc)
+        # the topology line an elastic shrink must change: ownership is
+        # re-derived from the CURRENT mesh at every init, so a
+        # supervisor relaunch with a smaller world re-shards features,
+        # not just the machine list (test_supervisor / test_comm)
+        d = self.topology.describe(self.f_pad)
+        Log.info("mesh: %d shard(s) x %d process(es), f_pad=%d"
+                 "%s, hist_exchange=%s, comm_precision=%s",
+                 d["shards"], d["processes"], d["f_pad"],
+                 f" (f_loc={d['f_loc']})" if "f_loc" in d else "",
+                 d["exchange"], d["precision"])
 
     # SerialTreeLearner.init calls these hooks -------------------------------
     def _pad_rows(self, n, chunk):
@@ -207,7 +180,7 @@ class _MeshedTreeLearner(SerialTreeLearner):
 
     def _pad_feature_count(self, f):
         if not self.shard_features:
-            return super()._pad_feature_count(f)  # ceil-4 when partitioned
+            return super()._pad_feature_count(f)
         k = self.n_shards
         return ((f + k - 1) // k) * k
 
@@ -268,14 +241,13 @@ class _MeshedTreeLearner(SerialTreeLearner):
     # (parallel/heartbeat.py; armed only when `collective_timeout_s`
     # is set, zero overhead otherwise).
     def train_device(self, grad, hess, inbag=None):
-        from ..ops.histogram import callbacks_disabled
-        # callbacks_disabled: the first call traces the jitted builder,
+        # meshed_trace_guard: the first call traces the jitted builder,
         # and host-callback kernels inside multi-device shard_map
         # programs deadlock this image's XLA CPU runtime — meshed
         # builders bake the pure-XLA segment kernel instead
-        # (ops/histogram.py chunk_mode)
+        # (parallel/mesh.py, ops/histogram.py chunk_mode)
         with collective_guard(f"{self.name}:tree_build"), \
-                callbacks_disabled():
+                meshed_trace_guard():
             return super().train_device(grad, hess, inbag)
 
     def local_row_leaf(self, out, n_local):
@@ -310,6 +282,48 @@ class _MeshedTreeLearner(SerialTreeLearner):
         if m is not None:
             m.inc("transfer_bytes", int(nbytes))
 
+    # ------------------------------------------------ collective-byte ledger
+    def account_tree_collectives(self, n_splits):
+        """Advance the `collective_bytes{kind}` counters by this tree's
+        realized wire bytes (mesh.py CommPlan; collective shapes are
+        static, so root + per-split × n_splits is exact). Called by the
+        boosting driver right after the per-tree leaf-count sync
+        (models/gbdt.py train_one_iter)."""
+        m = getattr(self, "metrics", None)
+        if m is not None and self._comm_plan is not None:
+            self._comm_plan.account(m, max(int(n_splits), 0))
+
+    def journal_fields(self):
+        """Per-iteration collective-byte deltas for the run journal
+        (models/gbdt.py train_one_iter; deltas are against the LAST
+        journal record so one record covers a multiclass iteration's K
+        builds)."""
+        self._journal_mesh_once()
+        m = getattr(self, "metrics", None)
+        if m is None:
+            return {}
+        cur = {k: int(m.counter(f"collective_bytes_{k}").value)
+               for k in COLLECTIVE_KINDS}
+        prev = self._journal_prev_comm or {k: 0 for k in cur}
+        self._journal_prev_comm = cur
+        return {"collective_bytes":
+                {k: cur[k] - prev.get(k, 0) for k in cur}}
+
+    def _journal_mesh_once(self):
+        """One `mesh` record per learner incarnation: the journal-side
+        proof that an elastic shrink re-sharded feature ownership (the
+        record's shards/f_loc change across a restart). Lazy because
+        the journal opens after learner init."""
+        if self._mesh_journaled:
+            return
+        from ..telemetry import journal as run_journal
+        j = run_journal.current()
+        if j is None:
+            return
+        self._mesh_journaled = True
+        j.event("mesh", learner=self.name,
+                **self.topology.describe(self.f_pad))
+
     def _out_specs(self):
         specs = {k: P() for k in _TREE_OUT_KEYS}
         if self.shard_rows:
@@ -320,30 +334,71 @@ class _MeshedTreeLearner(SerialTreeLearner):
 class DataParallelTreeLearner(_MeshedTreeLearner):
     """Row-sharded learner (data_parallel_tree_learner.cpp).
 
-    Two cores, selected like the serial learner's: the partitioned
-    (leaf-contiguous) builder — the default on TPU under
-    partitioned_build=auto — where each shard keeps its own layout and
-    every segment histogram is one f32 psum, matching the serial
-    partitioned learner up to f32 summation-order ulps; and the masked
-    builder (partitioned_build=false, and the non-TPU auto default)
-    whose deterministic Kahan pair-allreduce grows trees IDENTICAL to
-    the serial masked learner — the reference's structural
-    guarantee."""
+    Three cores, selected like the serial learner's:
+
+    - the partitioned (leaf-contiguous) builder — the default on TPU
+      under partitioned_build=auto — where each shard keeps its own
+      layout and every segment histogram is one f32 psum (through the
+      comm layer: `comm_precision=bf16` compresses the wire word),
+      matching the serial partitioned learner up to f32 summation-order
+      ulps;
+    - the masked builder's REDUCE-SCATTER exchange (the default
+      elsewhere; `hist_exchange=auto|reduce_scatter`): each shard owns
+      a contiguous feature block, the smaller child's Kahan pair is
+      all_to_all'd in `comm_groups` feature-shard groups (group g+1's
+      collective can be in flight while group g is being searched),
+      folded in fixed source order — bit-identical per owned feature to
+      the allgather-pair fold — and searched locally; the global best
+      is an allgather+argmax of one SplitInfo per shard. Trees are
+      IDENTICAL to the serial masked learner at `comm_precision=pair`;
+    - the masked builder's legacy ALLGATHER exchange
+      (`hist_exchange=allgather`, and bundled datasets whose stored-
+      slot histograms every shard must expand): the full-histogram
+      Kahan pair allgather with the same serial-parity guarantee, at
+      W× the wire bytes."""
     name = "data"
     shard_rows = True
     partitioned_capable = True
+
+    def _rs_eligible(self):
+        """Reduce-scatter runs on the masked core for unbundled
+        datasets on real (>1 shard) meshes. Bundled (EFB) datasets
+        exchange STORED-SLOT histograms that every shard must expand to
+        its virtual features, so ownership would not partition the
+        search; they keep the allgather exchange."""
+        return (not self._use_partitioned and self._bundle is None
+                and self.n_shards > 1
+                and resolve_hist_exchange(self.config) != "allgather")
+
+    def _pad_feature_count(self, f):
+        if self._use_partitioned or not self._rs_eligible():
+            return super()._pad_feature_count(f)
+        # reduce-scatter: every shard owns an equal contiguous block
+        k = self.n_shards
+        return ((f + k - 1) // k) * k
 
     def _make_build_core(self, cfg, chunk):
         num_leaves = int(cfg.num_leaves)
         max_bin = self.max_bin
         params = self.params
         max_depth = int(cfg.max_depth)
+        topo = self.topology
+        precision = topo.comm_precision
+        w = self.n_shards
+        self._comm_plan = plan = CommPlan()
 
         if self._use_partitioned:
             from ..models.partitioned import build_tree_partitioned
             f_real = self.num_features
-            psum = functools.partial(jax.lax.psum, axis_name=AXIS)
+            psum = functools.partial(compressed_psum, axis_name=AXIS,
+                                     precision=precision)
             cache_hists = self._cache_hists(cfg)
+            # segment histograms are (stored, B, 3) f32 psums (bf16
+            # halves the wire word); one reduction per root + per split
+            seg = self.f_pad * max_bin * 3 * (2 if precision == "bf16"
+                                              else 4)
+            plan.add("hist_reduce", root=psum_recv_bytes(seg, w),
+                     per_split=psum_recv_bytes(seg, w))
 
             def dp_part_fn(words, grad, hess, inbag, fmask, num_bin_pf,
                            is_cat):
@@ -356,23 +411,124 @@ class DataParallelTreeLearner(_MeshedTreeLearner):
 
             return self._row_sharded_map(dp_part_fn)
 
-        def dp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
-            # hist pair-allreduce already yields the GLOBAL histogram on
-            # every shard, and root sums are derived from it — so the
-            # scalar-sum hook is identity. Shard-local compaction (opt-
-            # in, _compaction_enabled) keeps the pair contract: each
-            # shard's compacted Kahan pair feeds the same fixed-order
-            # reduction.
+        # masked core: choose the histogram-exchange algorithm
+        use_rs = self._rs_eligible()
+        self._use_reduce_scatter = use_rs
+        if (resolve_hist_exchange(cfg) == "reduce_scatter" and not use_rs
+                and self.n_shards > 1):
+            Log.warning("hist_exchange=reduce_scatter unavailable for "
+                        "bundled datasets; using the allgather pair "
+                        "exchange")
+        hist_words = self.f_pad * max_bin * 3 * 4    # one f32 histogram
+
+        if not use_rs:
+            if precision == "pair":
+                exchange_fn = pair_allreduce
+                unit = 2 * allgather_recv_bytes(hist_words, w)
+            else:
+                exchange_fn = functools.partial(compressed_allreduce,
+                                                precision=precision)
+                unit = allgather_recv_bytes(
+                    hist_words // (2 if precision == "bf16" else 1), w)
+            plan.add("hist_reduce", root=unit, per_split=unit)
+
+            def dp_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
+                # the allgather exchange already yields the GLOBAL
+                # histogram on every shard, and root sums are derived
+                # from it — so the scalar-sum hook is identity.
+                # Shard-local compaction (opt-in, _compaction_enabled)
+                # keeps the pair contract: each shard's compacted Kahan
+                # pair feeds the same fixed-order reduction.
+                return build_tree_device(
+                    bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
+                    num_leaves=num_leaves, max_bin=max_bin, params=params,
+                    max_depth=max_depth, row_chunk=chunk,
+                    hist_psum_fn=exchange_fn,
+                    compact_hist=self._use_compact,
+                    use_frontier=self._use_frontier,
+                    **self._bundle_kwargs(bins, num_bin_pf))
+
+            return self._row_sharded_map(dp_fn)
+
+        # ---- reduce-scatter core -----------------------------------------
+        f_loc = topo.feature_shard(self.f_pad)
+        groups = topo.exchange_groups(f_loc)
+        self._comm_groups_effective = groups
+        if precision == "pair":
+            exchange_fn = functools.partial(pair_reduce_scatter,
+                                            n_shards=w, groups=groups)
+            unit = 2 * alltoall_recv_bytes(hist_words, w)
+        else:
+            exchange_fn = functools.partial(compressed_reduce_scatter,
+                                            n_shards=w, groups=groups,
+                                            precision=precision)
+            unit = alltoall_recv_bytes(
+                hist_words // (2 if precision == "bf16" else 1), w)
+        # one smaller-child exchange per split + the root build; the
+        # larger child is parent − smaller on the OWNED block (the
+        # cross-rank subtraction trick — never exchanged)
+        plan.add("hist_reduce", root=unit, per_split=unit)
+        # split search is local; the global best is one SplitInfo per
+        # shard (root evaluates once, each split evaluates 2 children)
+        sp_unit = allgather_recv_bytes(_SPLIT_INFO_BYTES, w)
+        plan.add("split_gather", root=sp_unit, per_split=2 * sp_unit)
+        # root sums broadcast from the global-feature-0 owner (3 scalars)
+        plan.add("leaf_sync", root=3 * psum_recv_bytes(4, w))
+        fg = f_loc // groups
+
+        def dp_rs_fn(bins, grad, hess, inbag, fmask, num_bin_pf, is_cat):
+            shard = jax.lax.axis_index(AXIS)
+            start = shard * f_loc
+            nbp_loc = jax.lax.dynamic_slice_in_dim(num_bin_pf, start, f_loc)
+            cat_loc = jax.lax.dynamic_slice_in_dim(is_cat, start, f_loc)
+            fm_loc = jax.lax.dynamic_slice_in_dim(fmask, start, f_loc)
+
+            def sum_bcast(s):
+                # root sums must come from GLOBAL feature 0 (the serial
+                # learner's convention) — shard 0 owns it; broadcast its
+                # value so every shard evaluates with identical parents
+                return jax.lax.psum(jnp.where(shard == 0, s, 0.0), AXIS)
+
+            def evaluate(hist3, sum_g, sum_h, cnt):
+                # hist3 is this shard's OWNED (f_loc, B, 3) block of the
+                # reduce-scattered histogram. Search it per exchange
+                # group: group g's gains depend only on group g's
+                # collective, so the scheduler can overlap group g+1's
+                # exchange with this search (mesh.py
+                # _scatter_feature_groups).
+                gains_parts, thr_parts = [], []
+                for g in range(groups):
+                    sl = slice(g * fg, (g + 1) * fg)
+                    gains_g, thr_g = per_feature_best(
+                        hist3[sl], sum_g, sum_h, cnt, nbp_loc[sl],
+                        cat_loc[sl], fm_loc[sl], params)
+                    gains_parts.append(gains_g)
+                    thr_parts.append(thr_g)
+                gains = jnp.concatenate(gains_parts)
+                thr = jnp.concatenate(thr_parts)
+                # within the shard: first max = smallest owned feature;
+                # across shards: first max = smallest shard — together
+                # the serial argmax tie-break, because ownership blocks
+                # ascend with shard index
+                best_local = jnp.argmax(gains).astype(jnp.int32)
+                sp = split_info_at(hist3, sum_g, sum_h, cnt, cat_loc,
+                                   params, best_local, thr[best_local],
+                                   gains[best_local])
+                sp = sp._replace(feature=sp.feature + start)
+                gathered = jax.lax.all_gather(sp, AXIS)
+                widx = jnp.argmax(gathered.gain)
+                return jax.tree_util.tree_map(lambda x: x[widx], gathered)
+
             return build_tree_device(
                 bins, grad, hess, inbag, fmask, num_bin_pf, is_cat,
                 num_leaves=num_leaves, max_bin=max_bin, params=params,
                 max_depth=max_depth, row_chunk=chunk,
-                hist_psum_fn=pair_allreduce,
+                hist_psum_fn=exchange_fn, sum_psum_fn=sum_bcast,
+                evaluate_fn=evaluate,
                 compact_hist=self._use_compact,
-                use_frontier=self._use_frontier,
-                **self._bundle_kwargs(bins, num_bin_pf))
+                use_frontier=self._use_frontier)
 
-        return self._row_sharded_map(dp_fn)
+        return self._row_sharded_map(dp_rs_fn)
 
 
 class FeatureParallelTreeLearner(_MeshedTreeLearner):
@@ -465,10 +621,24 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
         f_loc = self.f_pad // self.n_shards
         compact = self._use_compact
         use_frontier = self._use_frontier
+        w = self.n_shards
+        self._comm_plan = plan = CommPlan()
 
         replicated = self._bins_replicated is not None
         bundled = getattr(self, "_bundle", None) is not None
         s_loc = self._fp_s_loc if bundled else f_loc
+
+        # the Allreduce-max of SplitInfo: root evaluates once, every
+        # split evaluates both children
+        sp_unit = allgather_recv_bytes(_SPLIT_INFO_BYTES, w)
+        plan.add("split_gather", root=sp_unit, per_split=2 * sp_unit)
+        # root-sum broadcast (3 scalars, once per tree)
+        plan.add("leaf_sync", root=3 * psum_recv_bytes(4, w))
+        if not replicated:
+            # owner-broadcast of the (N_pad,) int32 split column at
+            # every partition update
+            plan.add("leaf_sync",
+                     per_split=psum_recv_bytes(self.n_pad * 4, w))
 
         # replicated bundle tables are closed over (same pattern as the
         # row-sharded learners' _bundle_kwargs); only the genuinely
@@ -573,7 +743,9 @@ class FeatureParallelTreeLearner(_MeshedTreeLearner):
 
 class VotingParallelTreeLearner(_MeshedTreeLearner):
     """PV-Tree (voting_parallel_tree_learner.cpp): rows sharded, but only
-    the top-voted features' histograms are globally reduced."""
+    the top-voted features' histograms are globally reduced — the
+    selective reduction and the vote gathers ride the shared comm layer
+    (comm_precision compression + collective_bytes accounting)."""
     name = "voting"
     shard_rows = True
     partitioned_capable = True
@@ -587,12 +759,26 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
         f = self.num_features
         top_k = min(top_k, f)
         n_shards = self.n_shards
+        w = n_shards
+        precision = self.topology.comm_precision
+        self._comm_plan = plan = CommPlan()
+        # the voting comms story: two tiny top-k gathers + ONE selective
+        # psum of the <=top_k winning features per evaluation (root
+        # evaluates once, each split twice); root sums once per tree
+        vote_unit = 2 * allgather_recv_bytes(top_k * 4, w)
+        sel = top_k * max_bin * 3 * (2 if precision == "bf16" else 4)
+        sel_unit = psum_recv_bytes(sel, w)
+        plan.add("split_gather", root=vote_unit, per_split=2 * vote_unit)
+        plan.add("hist_reduce", root=sel_unit, per_split=2 * sel_unit)
+        plan.add("leaf_sync", root=3 * psum_recv_bytes(4, w))
         # local vote constraints scaled by 1/num_machines
         # (voting_parallel_tree_learner.cpp:52-54)
         local_params = params._replace(
             min_data_in_leaf=params.min_data_in_leaf / self.n_shards,
             min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / self.n_shards)
         psum = functools.partial(jax.lax.psum, axis_name=AXIS)
+        sel_psum = functools.partial(compressed_psum, axis_name=AXIS,
+                                     precision=precision)
 
         def make_evaluate(fmask, num_bin_pf, is_cat):
             """The vote-and-selectively-reduce split evaluation, shared
@@ -617,8 +803,8 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 # keep the best; the global candidate set is the top-k
                 # features by that score (lax.top_k's lowest-index tie
                 # order plays ArrayArgs::MaxK's stable partial sort)
-                w = local_c * (n_shards / jnp.maximum(cnt, 1.0))
-                top_wg = jnp.where(jnp.isfinite(top_g), top_g * w,
+                w_gain = local_c * (n_shards / jnp.maximum(cnt, 1.0))
+                top_wg = jnp.where(jnp.isfinite(top_g), top_g * w_gain,
                                    K_MIN_SCORE)
                 all_top = jax.lax.all_gather(local_top, AXIS).reshape(-1)
                 all_wg = jax.lax.all_gather(top_wg, AXIS).reshape(-1)
@@ -631,8 +817,9 @@ class VotingParallelTreeLearner(_MeshedTreeLearner):
                 voted = jnp.isfinite(jnp.take(feature_best, selected))
                 # selective reduction: psum ONLY the voted features'
                 # histograms (the analog of the <=2k-feature ReduceScatter,
-                # CopyLocalHistogram :167-230)
-                hist_sel = psum(jnp.take(hist3, selected, axis=0))
+                # CopyLocalHistogram :167-230) — through the comm layer
+                # so comm_precision compresses the wire word
+                hist_sel = sel_psum(jnp.take(hist3, selected, axis=0))
                 gains_sel, thr_sel = per_feature_best(
                     hist_sel, sum_g, sum_h, cnt,
                     jnp.take(num_bin_pf, selected),

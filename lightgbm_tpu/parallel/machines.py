@@ -81,6 +81,24 @@ def format_machine_list(machines):
     return "\n".join(lines) + "\n"
 
 
+def partition_features(num_features, num_shards, shard):
+    """Contiguous owned feature block of one shard under the mesh
+    layer's reduce-scatter ownership rule (parallel/mesh.py): features
+    are padded to a multiple of `num_shards` and shard r owns
+    [r*f_loc, (r+1)*f_loc). Returns (lo, hi) in PADDED feature space
+    (hi may exceed num_features for trailing shards — those indices are
+    pad features that never win a split).
+
+    jax-free on purpose, like the machine-list helpers above: the
+    supervisor and diagnostics tooling can state how an elastic shrink
+    re-shards ownership without touching the accelerator runtime."""
+    num_shards = max(int(num_shards), 1)
+    f_pad = -(-int(num_features) // num_shards) * num_shards
+    f_loc = f_pad // num_shards
+    lo = int(shard) * f_loc
+    return lo, lo + f_loc
+
+
 def _local_addresses():
     names = {"localhost", "127.0.0.1", socket.gethostname()}
     try:

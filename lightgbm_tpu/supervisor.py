@@ -261,6 +261,7 @@ class Supervisor:
                                  f"exhausted")
                 return code
             attempt += 1
+            prev_world = len(self.members)
             if len(self.members) > 1:
                 survivors = restart_barrier(
                     self.shared_dir, attempt, self.rank, self.members,
@@ -271,14 +272,18 @@ class Supervisor:
                     [self.machines[r] for r in survivors], attempt)
                 new_rank = survivors.index(self.rank)
                 mlist_override = self._write_shrunk_mlist(machines, attempt)
+            shrunk = len(self.members) < prev_world
             self._journal_event("restart", attempt=attempt,
                                 exit_code=int(code),
                                 reason=describe_exit(code),
                                 survivors=list(self.members),
-                                new_rank=int(new_rank))
+                                new_rank=int(new_rank),
+                                mesh_reshard=bool(shrunk))
             Log.info("supervisor: restarting rank %d as rank %d of %d "
-                     "(resume from newest snapshot under %s)", self.rank,
-                     new_rank, max(len(machines), 1), self.shared_dir)
+                     "(%sresume from newest snapshot under %s)", self.rank,
+                     new_rank, max(len(machines), 1),
+                     "mesh re-shards feature ownership; " if shrunk
+                     else "", self.shared_dir)
 
 
 def main(argv=None):

@@ -40,7 +40,7 @@ TID_SUPERVISOR = 1
 TID_SPAN_BASE = 16   # span recording threads map to 16, 17, ...
 
 _INSTANT_EVENTS = {"run_start", "run_end", "resume", "truncate",
-                   "abort", "restart", "note", "config"}
+                   "abort", "restart", "note", "config", "mesh"}
 
 
 def collect_records(source):
@@ -185,6 +185,16 @@ def build_trace(records):
             b.counter(rank, "training_health", ts,
                       {k: rec[k] for k in ("grad_norm", "hess_norm",
                                            "leaf_count") if k in rec})
+            comm = rec.get("collective_bytes")
+            if isinstance(comm, dict):
+                # meshed-learner wire-byte track (parallel/mesh.py
+                # CommPlan deltas): plots hist_reduce/split_gather/
+                # leaf_sync next to the phase slices, so a comms-bound
+                # iteration is visible at a glance
+                vals = {k: v for k, v in comm.items()
+                        if isinstance(v, (int, float))}
+                if vals:
+                    b.counter(rank, "collective_bytes", ts, vals)
         elif event == "metrics":
             b.counter(rank, "metrics", ts, rec.get("values") or {})
         elif event == "quality":
@@ -235,6 +245,10 @@ def build_trace(records):
                 name = f"restart attempt={rec.get('attempt')}"
             elif event == "resume":
                 name = f"resume @{rec.get('iteration')}"
+            elif event == "mesh":
+                # mesh (re-)derivation marker: across an elastic shrink
+                # the shards/f_loc args change between two of these
+                name = f"mesh {rec.get('shards')} shard(s)"
             b.instant(rank, tid, name, ts, args or None)
         # unknown events are skipped: the exporter must keep working on
         # journals from a newer schema

@@ -63,7 +63,10 @@ SCHEMA = {
                                # out-of-core streaming (data/ooc_learner)
                                "prefetch_wait_s": float,
                                "prefetch_bytes": int,
-                               "prefetch_overlap_pct": float}},
+                               "prefetch_overlap_pct": float,
+                               # per-kind collective wire bytes this
+                               # iteration (parallel/mesh.py CommPlan)
+                               "collective_bytes": dict}},
     "metrics": {"required": {"iteration": int, "values": dict},
                 "optional": {}},
     # model-quality deltas per iteration/block (`quality_telemetry`
@@ -89,7 +92,17 @@ SCHEMA = {
                            "dead_ranks": list, "source": str}},
     "restart": {"required": {"attempt": int, "exit_code": int},
                 "optional": {"reason": str, "survivors": list,
-                             "new_rank": int, "source": str}},
+                             "new_rank": int, "source": str,
+                             # world shrank: the relaunch re-derives
+                             # the mesh and feature ownership
+                             "mesh_reshard": bool}},
+    # one record per meshed-learner incarnation (parallel/learners.py):
+    # shard count + feature ownership — across an elastic shrink the
+    # journal shows the mesh re-sharding, not just the machine list
+    "mesh": {"required": {"shards": int},
+             "optional": {"processes": int, "precision": str,
+                          "exchange": str, "f_pad": int, "f_loc": int,
+                          "learner": str, "source": str}},
     "run_end": {"required": {"iterations": int},
                 "optional": {"train_s": float, "source": str}},
     # device-memory watermarks sampled at iteration/block boundaries

@@ -1,0 +1,314 @@
+"""Mesh communication layer: reduce-scatter histogram exchange,
+comm_precision compression, collective-byte accounting, and elastic
+mesh re-sharding (parallel/mesh.py + the learners riding it).
+
+The contract hierarchy mirrors the reference's:
+- `comm_precision=pair` reduce-scatter grows trees IDENTICAL to the
+  serial learner (the fixed-order Kahan fold is feature-local, so
+  scattering features across shards cannot change any cell);
+- `f32`/`bf16` trade that for wire bytes and get an AUC-tolerance bar;
+- the per-tree wire bytes are DECLARED (mesh.py CommPlan) and the
+  counters must advance by exactly the declared amounts — the same
+  closed form bench.py dist_probe and docs/Parallel-Learning.md quote.
+"""
+
+import numpy as np
+import pytest
+from sklearn import datasets
+from sklearn.metrics import roc_auc_score
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import DatasetLoader
+from lightgbm_tpu.models.gbdt import create_boosting
+from lightgbm_tpu.objectives import create_objective
+from lightgbm_tpu.parallel.mesh import (CommPlan, MeshTopology,
+                                        allgather_recv_bytes,
+                                        alltoall_recv_bytes, make_mesh,
+                                        psum_recv_bytes)
+
+
+def _train(cfg, X, y, rounds=10):
+    ds = DatasetLoader(cfg).construct_from_matrix(X, label=y)
+    obj = create_objective(cfg.objective, cfg)
+    obj.init(ds.metadata, ds.num_data)
+    g = create_boosting(cfg.boosting_type)
+    g.init(cfg, ds, obj, [])
+    for _ in range(rounds):
+        if g.train_one_iter(is_eval=False):
+            break
+    return g
+
+
+def _cfg(learner, machines=4, **kw):
+    params = {"objective": "binary", "num_leaves": 15,
+              "learning_rate": 0.1, "min_data_in_leaf": 10,
+              "tree_learner": learner, "verbose": -1, "metric_freq": 0,
+              "device_row_chunk": 256,
+              "num_machines": 1 if learner == "serial" else machines}
+    params.update(kw)
+    cfg = Config.from_params(params)
+    if learner != "serial":
+        assert cfg.tree_learner == learner
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = datasets.load_breast_cancer(return_X_y=True)
+    return X, y
+
+
+def _assert_identical_trees(ga, gb, leaf_rtol=1e-5):
+    assert len(ga.models) == len(gb.models)
+    for ta, tb in zip(ga.models, gb.models):
+        assert ta.num_leaves == tb.num_leaves
+        np.testing.assert_array_equal(ta.split_feature_real,
+                                      tb.split_feature_real)
+        np.testing.assert_array_equal(ta.threshold_in_bin,
+                                      tb.threshold_in_bin)
+        np.testing.assert_allclose(ta.leaf_value, tb.leaf_value,
+                                   rtol=leaf_rtol, atol=1e-7)
+
+
+# ------------------------------------------------- reduce-scatter parity
+
+@pytest.mark.parametrize("machines", [2, 4])
+def test_reduce_scatter_bit_parity_with_sampling(data, machines):
+    """THE tentpole contract: the reduce-scatter data-parallel path is
+    the default AND still grows the serial learner's trees exactly —
+    with bagging and feature_fraction on, so the per-tree masks and
+    in-bag weights ride the owned-shard search too."""
+    X, y = data
+    knobs = {"bagging_fraction": 0.7, "bagging_freq": 1,
+             "feature_fraction": 0.8}
+    gs = _train(_cfg("serial", **knobs), X, y, rounds=8)
+    gd = _train(_cfg("data", machines=machines, **knobs), X, y, rounds=8)
+    assert gd.tree_learner._use_reduce_scatter
+    _assert_identical_trees(gs, gd)
+
+
+def test_reduce_scatter_multiclass_parity():
+    """Multiclass = K trees per iteration through the same owned-shard
+    search; all of them must match serial exactly."""
+    rng = np.random.RandomState(9)
+    n, f, k = 1500, 10, 3
+    X = rng.rand(n, f).astype(np.float32)
+    score = np.stack([X[:, i] + 0.3 * rng.randn(n) for i in range(k)])
+    y = np.argmax(score, axis=0).astype(np.float32)
+
+    def cfg(learner):
+        return _cfg(learner, objective="multiclass", num_class=3,
+                    metric="multi_logloss")
+
+    gs = _train(cfg("serial"), X, y, rounds=4)
+    gd = _train(cfg("data"), X, y, rounds=4)
+    assert gd.tree_learner._use_reduce_scatter
+    _assert_identical_trees(gs, gd)
+
+
+def test_allgather_knob_restores_legacy_exchange(data):
+    """hist_exchange=allgather keeps the full-histogram pair allgather
+    — same serial parity, W x the declared wire bytes."""
+    X, y = data
+    gs = _train(_cfg("serial"), X, y)
+    ga = _train(_cfg("data", hist_exchange="allgather"), X, y)
+    assert not ga.tree_learner._use_reduce_scatter
+    _assert_identical_trees(gs, ga)
+    grs = _train(_cfg("data"), X, y)
+    rs_hist = grs.tree_learner._comm_plan.per_split["hist_reduce"]
+    ag_hist = ga.tree_learner._comm_plan.per_split["hist_reduce"]
+    # allgather-pair moves W x the reduce-scatter bytes per exchange
+    assert ag_hist >= 3 * rs_hist
+
+
+def test_comm_groups_do_not_change_trees(data):
+    """Grouped (double-buffered) exchange is a scheduling construct:
+    per-cell numerics are identical at any group count."""
+    X, y = data
+    g1 = _train(_cfg("data", comm_groups=1), X, y, rounds=5)
+    g2 = _train(_cfg("data", comm_groups=2), X, y, rounds=5)
+    g3 = _train(_cfg("data", comm_groups=5), X, y, rounds=5)
+    _assert_identical_trees(g1, g2, leaf_rtol=0)
+    _assert_identical_trees(g1, g3, leaf_rtol=0)
+
+
+# -------------------------------------------------- lossy comm_precision
+
+@pytest.mark.parametrize("precision", ["f32", "bf16"])
+def test_comm_precision_auc_tolerance(data, precision):
+    """f32/bf16 compression is applied at the collective boundary only:
+    trees may differ from serial, model quality must not (AUC within
+    0.005 of the serial run on the training set)."""
+    X, y = data
+    gs = _train(_cfg("serial"), X, y)
+    gd = _train(_cfg("data", comm_precision=precision), X, y)
+    assert gd.tree_learner._use_reduce_scatter
+    auc_s = roc_auc_score(y, gs.predict(X)[:, 0])
+    auc_d = roc_auc_score(y, gd.predict(X)[:, 0])
+    assert auc_s > 0.98
+    assert abs(auc_s - auc_d) < 0.005
+    # the plan reflects the compression: fewer hist bytes than pair
+    pair_plan = _train(_cfg("data"), X, y, rounds=1) \
+        .tree_learner._comm_plan
+    lossy_plan = gd.tree_learner._comm_plan
+    assert (lossy_plan.per_split["hist_reduce"]
+            < pair_plan.per_split["hist_reduce"])
+
+
+def test_voting_rides_comm_layer(data):
+    """The voting learner's selective reduction goes through the shared
+    comm layer: bf16 compression still clears the accuracy bar and the
+    hist_reduce/split_gather counters advance."""
+    X, y = data
+    gv = _train(_cfg("voting", comm_precision="bf16", top_k=10), X, y,
+                rounds=20)
+    p = gv.predict(X)[:, 0]
+    assert np.mean((p > 0.5) != y) < 0.05
+    snap = gv.metrics.snapshot()["counters"]
+    assert snap["collective_bytes_hist_reduce"] > 0
+    assert snap["collective_bytes_split_gather"] > 0
+    assert snap["collective_bytes"] > 0
+
+
+# --------------------------------------------- collective-byte ledger
+
+def test_collective_bytes_match_declared_plan(data):
+    """The counters must advance by EXACTLY the declared wire plan:
+    sum over trees of root + per_split * n_splits, per kind."""
+    X, y = data
+    g = _train(_cfg("data"), X, y, rounds=6)
+    learner = g.tree_learner
+    plan = learner._comm_plan
+    splits = [t.num_leaves - 1 for t in g.models]
+    snap = g.metrics.snapshot()["counters"]
+    total = 0
+    for kind in ("hist_reduce", "split_gather", "leaf_sync"):
+        want = sum(plan.root[kind] + plan.per_split[kind] * s
+                   for s in splits)
+        assert snap[f"collective_bytes_{kind}"] == want, kind
+        total += want
+    assert snap["collective_bytes"] == total
+    assert total > 0
+
+
+def test_collective_bytes_formulas():
+    """Pin the wire models + CommPlan closed form (the numbers the docs
+    and dist_probe quote)."""
+    assert allgather_recv_bytes(100, 4) == 300
+    assert alltoall_recv_bytes(100, 4) == 75
+    assert psum_recv_bytes(100, 4) == 150
+    plan = CommPlan()
+    plan.add("hist_reduce", root=10, per_split=7)
+    plan.add("split_gather", per_split=2)
+    pt = plan.per_tree(3)
+    assert pt == {"hist_reduce": 31, "split_gather": 6, "leaf_sync": 0}
+    from lightgbm_tpu.telemetry.registry import MetricsRegistry
+    reg = MetricsRegistry()
+    plan.account(reg, 3)
+    snap = reg.snapshot()["counters"]
+    assert snap["collective_bytes_hist_reduce"] == 31
+    assert snap["collective_bytes"] == 37
+    with pytest.raises(ValueError):
+        plan.add("bogus", root=1)
+
+
+def test_collective_bytes_journaled_with_mesh_event(tmp_path, data):
+    """telemetry=true: iteration records carry the per-kind byte
+    deltas, and one `mesh` record per learner incarnation names the
+    shard count + feature ownership (the elastic-shrink audit trail).
+    Every record passes the schema lint."""
+    from lightgbm_tpu.telemetry.journal import read_journal, validate_record
+    X, y = data
+    g = _train(_cfg("data", telemetry=True,
+                    telemetry_dir=str(tmp_path)), X, y, rounds=3)
+    records, bad = read_journal(g.journal.path)
+    assert bad == 0
+    for rec in records:
+        assert validate_record(rec) == [], rec
+    mesh_recs = [r for r in records if r["event"] == "mesh"]
+    assert len(mesh_recs) == 1
+    assert mesh_recs[0]["shards"] == 4
+    assert mesh_recs[0]["f_pad"] % 4 == 0
+    assert mesh_recs[0]["f_loc"] == mesh_recs[0]["f_pad"] // 4
+    assert mesh_recs[0]["exchange"] in ("auto", "reduce_scatter")
+    it_recs = [r for r in records if r["event"] == "iteration"]
+    assert it_recs
+    per_kind = {}
+    for rec in it_recs:
+        cb = rec.get("collective_bytes")
+        assert cb is not None
+        for k, v in cb.items():
+            per_kind[k] = per_kind.get(k, 0) + v
+    snap = g.metrics.snapshot()["counters"]
+    assert per_kind["hist_reduce"] == snap["collective_bytes_hist_reduce"]
+
+
+# -------------------------------------------------- elastic mesh re-shard
+
+def test_mesh_topology_feature_ownership():
+    from lightgbm_tpu.parallel.machines import partition_features
+    cfg4 = _cfg("data", machines=4)
+    topo4 = MeshTopology(make_mesh(cfg4), cfg4)
+    assert topo4.n_shards == 4
+    assert topo4.feature_shard(32) == 8
+    assert topo4.exchange_groups(8) == 2      # comm_groups default 2
+    assert topo4.exchange_groups(7) == 1      # must divide the block
+    d = topo4.describe(32)
+    assert d["shards"] == 4 and d["f_loc"] == 8
+    # the jax-free ownership rule (supervisor side) and the mesh's view
+    # are the same function
+    assert topo4.owned_block(1, 32) == (8, 16)
+    assert partition_features(30, 4, 0) == (0, 8)
+    assert partition_features(30, 4, 3) == (24, 32)  # pad tail
+    cfg2 = _cfg("data", machines=2)
+    topo2 = MeshTopology(make_mesh(cfg2), cfg2)
+    assert topo2.describe(32)["f_loc"] == 16  # ownership re-shards
+
+
+def test_elastic_shrink_reshards_mesh_and_resumes(tmp_path, data):
+    """The supervisor-shrink contract at the mesh level: a run
+    checkpointed on a 4-shard mesh is killed and resumed on a 2-shard
+    mesh (the shrunken world). Feature ownership re-shards (f_loc
+    doubles), training resumes from the snapshot, and — because the
+    pair exchange is topology-independent — the final trees still match
+    an uninterrupted 4-shard run."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import callback
+    from lightgbm_tpu.utils import faults
+
+    X, y = data
+    params4 = {"objective": "binary", "num_leaves": 15,
+               "min_data_in_leaf": 10, "tree_learner": "data",
+               "num_machines": 4, "verbose": -1, "metric_freq": 0}
+
+    def run(params, ckpt_dir=None, crash_at=None, resume=False,
+            rounds=12):
+        train_set = lgb.Dataset(X, y, params=params)
+        cbs = [callback.checkpoint(ckpt_dir, period=5)] if ckpt_dir \
+            else []
+        if crash_at is not None:
+            faults.set_fault("crash_at_iteration", crash_at)
+        try:
+            return lgb.train(params, train_set, num_boost_round=rounds,
+                             verbose_eval=False, callbacks=cbs,
+                             resume_from=ckpt_dir if resume else None)
+        except faults.InjectedFault:
+            return None
+        finally:
+            faults.clear_faults()
+
+    ref = run(params4)
+    d = str(tmp_path / "ck")
+    crashed = run(params4, ckpt_dir=d, crash_at=8)
+    assert crashed is None
+    # the shrunken world: half the shards survive
+    params2 = dict(params4, num_machines=2)
+    resumed = run(params2, ckpt_dir=d, resume=True)
+    assert resumed is not None
+    learner = resumed.gbdt.tree_learner
+    assert learner.topology.n_shards == 2
+    assert (learner.topology.describe(learner.f_pad)["f_loc"]
+            == learner.f_pad // 2)
+    # resumed past the snapshot, and the trees match the uninterrupted
+    # 4-shard run (structure exactly; leaf values to fp tolerance)
+    _assert_identical_trees(ref.gbdt, resumed.gbdt)

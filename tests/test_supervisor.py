@@ -380,5 +380,10 @@ def test_shrunken_world_restart_smoke(tmp_path):
     assert p0.returncode == 0, out0[-3000:]
     assert "shrinking the world to 1 rank(s)" in out0
     assert "Resuming from checkpoint" in out0
+    # pre-shrink the meshed learner announced its 4-shard topology
+    # (2 procs x 2 virtual devices); the relaunch shrank to ONE
+    # machine, which check_param_conflict coerces to the serial
+    # learner — the mesh itself was re-derived, not just the list
+    assert "mesh: 4 shard(s) x 2 process(es)" in out0
     model = (tmp_path / "shrink" / "model.txt").read_text()
     assert model.count("Tree=") == 6  # resumed past the crash to the end

@@ -249,12 +249,70 @@ def check_ooc():
     return ok
 
 
+def check_dist():
+    """Distributed comms guard (`make verify-dist-perf`; the bench's
+    dist_probe in gate form): the 2-process gloo CPU data-parallel rung
+    must (1) keep per-tree collective wire bytes within VERIFY_DIST_TOL
+    (default 15%) of the committed `dist_collective_bytes_per_tree`
+    baseline, and (2) stay >= VERIFY_DIST_MIN_REDUCTION (default 3x)
+    below the legacy allgather-pair exchange measured side by side —
+    the reduce-scatter refactor's acceptance bar."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import bench
+    res = bench.dist_probe(
+        timeout_s=int(os.environ.get("VERIFY_DIST_TIMEOUT", "480")))
+    if "error" in res:
+        print(f"verify-dist: probe failed: {res['error']}")
+        return False
+    ok = True
+    print(f"verify-dist: {res['rows']} rows x {res['iters']} iters, "
+          f"{res['trees']} trees, sync wait {res['sync_wait_s']:.2f}s, "
+          f"{res['rows_s']:.0f} rows/s "
+          f"({res['rows_s_vs_serial']:.2f}x serial)")
+    bpt = res["collective_bytes_per_tree"]
+    reduction = res["bytes_reduction_vs_allgather"]
+    min_red = float(os.environ.get("VERIFY_DIST_MIN_REDUCTION", "3.0"))
+    if reduction < min_red:
+        print(f"verify-dist: reduce-scatter moves only {reduction:.2f}x "
+              f"fewer bytes/tree than allgather-pair "
+              f"({bpt / 1e6:.2f} vs {res['allgather_bytes_per_tree'] / 1e6:.2f} MB) "
+              f"-> BELOW {min_red:.0f}x BAR")
+        ok = False
+    else:
+        print(f"verify-dist: bytes/tree {bpt / 1e6:.2f} MB vs allgather "
+              f"{res['allgather_bytes_per_tree'] / 1e6:.2f} MB "
+              f"({reduction:.2f}x reduction, >= {min_red:.0f}x) -> OK")
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    base_bpt = base.get("dist_collective_bytes_per_tree")
+    if not base_bpt:
+        print("verify-dist: baseline has no dist_collective_bytes_per_tree"
+              " — regression gate skipped (bump BENCH_BASELINE.json to "
+              "arm)")
+        return ok
+    tol = float(os.environ.get("VERIFY_DIST_TOL", "0.15"))
+    limit = base_bpt * (1.0 + tol)
+    good = bpt <= limit
+    print(f"verify-dist: bytes/tree {bpt / 1e6:.2f} MB vs baseline "
+          f"{base_bpt / 1e6:.2f} MB (limit {limit / 1e6:.2f} MB) -> "
+          f"{'OK' if good else 'REGRESSION'}")
+    return ok and good
+
+
 def main():
     if "--ooc" in sys.argv:
         if not check_ooc():
             print("verify-ooc: FAILED")
             return 1
         print("verify-ooc: all checks passed")
+        return 0
+    if "--dist" in sys.argv:
+        if not check_dist():
+            print("verify-dist: FAILED")
+            return 1
+        print("verify-dist: all checks passed")
         return 0
     ok = check_speed()
     ok = check_journal_tracer_consistency() and ok
