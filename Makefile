@@ -87,6 +87,21 @@ verify-quality:
 	  tests/test_quality.py tests/test_drift.py -q -m 'not slow' \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
 
+# fleet suite: model registry atomicity/CRC/rollback, hot-swap under
+# concurrent traffic (no mixed-version responses, no 5xx, zero cold
+# dispatches), bf16 serving-precision bound, graceful drain — then the
+# acceptance guard (bench fleet_probe via tools/verify_perf.py
+# --fleet: sustained-QPS rung with a mid-run hot-swap; p99 during the
+# swap gated against steady-state and BENCH_BASELINE.json, bf16
+# throughput win + pinned accuracy bound). The pytest leg includes the
+# end-to-end drift -> retrain -> validate -> promote rung on a
+# shifted-traffic replay.
+verify-fleet:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_fleet.py -q \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --fleet
+
 # out-of-core suite: block-store build/validate/reuse, streamed-vs-
 # in-RAM bitwise parity across objectives/sampling, crash->resume,
 # corrupt-store detection — then the acceptance guard (bench ooc_probe
@@ -101,4 +116,5 @@ clean:
 	rm -f $(TARGET)
 
 .PHONY: all test-capi verify-fault verify-dist verify-dist-perf \
-	verify-serve verify-obs verify-perf verify-quality verify-ooc clean
+	verify-serve verify-obs verify-perf verify-quality verify-fleet \
+	verify-ooc clean

@@ -808,6 +808,159 @@ def serving_probe(booster, x):
     return out
 
 
+def fleet_probe(timeout_s=300):
+    """Fleet/hot-swap acceptance probe (docs/Fleet.md): stand up an
+    in-process serving fleet on the CPU rung, drive sustained QPS at
+    it with the fleet load generator, hot-swap a challenger mid-run,
+    and report `serving.steady_p50_ms` / `serving.steady_p99_ms` /
+    `serving.p99_during_swap_ms` (the number `make verify-fleet`
+    gates), swap error/cold-dispatch counts, and the bf16-vs-f32
+    all-device traversal throughput ratio with its pinned accuracy
+    bound. tools/verify_perf.py --fleet guards these numbers."""
+    import shutil
+    import tempfile
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.fleet import ModelRegistry
+    from lightgbm_tpu.fleet.hotswap import HotSwapper
+    from lightgbm_tpu.fleet.loadgen import LoadGenerator
+    from lightgbm_tpu.serving import CompiledPredictor, make_server
+
+    out = {}
+    d = tempfile.mkdtemp(prefix="bench_fleet_")
+    srv = None
+    deadline = time.time() + timeout_s
+    try:
+        n = int(os.environ.get("BENCH_FLEET_ROWS", "20000"))
+        x, y = make_data(n)
+        params = {"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 20, "verbose": -1}
+        _mark(f"fleet probe: training incumbent + challenger ({n} rows)")
+        ds = lgb.Dataset(x, y, params=dict(params))
+        inc = lgb.train(dict(params), ds, num_boost_round=5,
+                        verbose_eval=False)
+        chal = lgb.train(dict(params), ds, num_boost_round=10,
+                         verbose_eval=False)
+        reg = ModelRegistry(os.path.join(d, "registry"))
+        paths = {}
+        for name, booster in (("incumbent", inc), ("challenger", chal)):
+            paths[name] = os.path.join(d, f"{name}.txt")
+            booster.save_model(paths[name])
+        v1 = reg.publish(paths["incumbent"])
+        v2 = reg.publish(paths["challenger"])
+        reg.promote(v1, reason="bench bootstrap")
+        pred = CompiledPredictor.from_model_file(reg.model_path(v1),
+                                                 max_batch_rows=256)
+        srv = make_server(pred, port=0, max_wait_ms=1.0,
+                          model_version=v1)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        qps = float(os.environ.get("BENCH_FLEET_QPS", "150"))
+        duration = min(float(os.environ.get("BENCH_FLEET_DURATION_S",
+                                            "6")),
+                       max(2.0, deadline - time.time() - 60))
+        rows_per_req = 8
+        batches = [np.ascontiguousarray(x[i * rows_per_req:
+                                          (i + 1) * rows_per_req],
+                                        dtype=np.float32)
+                   for i in range(8)]
+        _mark(f"fleet probe: load generator {qps:.0f} qps x "
+              f"{duration:.0f}s, swap mid-run")
+        gen = LoadGenerator(url, batches, qps=qps, workers=6,
+                            duration_s=duration)
+        gen.run(background=True)
+        time.sleep(duration * 0.4)   # steady state first
+        swapper = HotSwapper(srv, reg)
+        gen.mark_start("swap")
+        t_swap0 = time.time()
+        swapper.swap_to(v2, reason="bench hot-swap")
+        swap_s = time.time() - t_swap0
+        # hold the measured window open past the flip so the p99 rests
+        # on a real sample count, not the 2-3 requests a fast swap spans
+        time.sleep(max(0.0, 0.75 - swap_s))
+        gen.mark_end("swap")
+        gen.join(timeout=max(30.0, duration * 3))
+        rep = gen.report()
+        out.update({
+            "requests": rep["requests"],
+            "errors": rep["errors"],
+            "achieved_qps": rep.get("achieved_qps", 0.0),
+            "steady_p50_ms": rep.get("steady_p50_ms", 0.0),
+            "steady_p99_ms": rep.get("steady_p99_ms", 0.0),
+            "p99_during_swap_ms": rep.get("p99_during_swap_ms", 0.0),
+            "swap_window_s": rep.get("swap_window_s", 0.0),
+            "swap_window_requests": rep.get("swap_window_requests", 0),
+            "swap_s": round(swap_s, 3),
+            "swap_warmup_s": swapper.stats["last_warmup_s"],
+            # the flip contract: the challenger AOT-warmed behind the
+            # incumbent, so no post-swap request ever traced (0 means
+            # every dispatch across the flip hit a warmed shape)
+            "cold_dispatches": int(
+                srv.predictor.stats["cold_dispatches"]),
+            "served_version": int(srv.model_version),
+        })
+        # ---- bf16 value-stage precision vs the f32 serving paths ----
+        # the gated ratio compares what the /predict_raw endpoint
+        # actually dispatches under each serving_precision setting:
+        # f32 = the exact host-reduce contract, bf16 = the all-device
+        # bf16 value stage. The all-device f32 variant rides along as
+        # a reference point.
+        _mark("fleet probe: bf16 vs f32 traversal throughput")
+        rows = np.ascontiguousarray(x[:min(n, 50_000)], np.float32)
+        # measured on a realistically sized ensemble: at the swap
+        # pair's 5-10 trees the value stage is noise; the precision
+        # knob is priced where serving fleets live (tens of trees)
+        bf16_rounds = int(os.environ.get("BENCH_FLEET_BF16_TREES", "32"))
+        big = lgb.train(dict(params), ds, num_boost_round=bf16_rounds,
+                        verbose_eval=False)
+        g = big.gbdt
+        p32 = CompiledPredictor.from_booster(g, max_batch_rows=4096,
+                                             warm_device_kernels=True)
+        p16 = CompiledPredictor.from_booster(g, max_batch_rows=4096,
+                                             serving_precision="bf16")
+        reps = int(os.environ.get("BENCH_FLEET_BF16_REPS", "20"))
+        for f in (p32.predict_raw, p32.predict_raw_device,
+                  p16.predict_raw):
+            f(rows)                      # first-touch outside timing
+
+        def timed(f):
+            t0 = time.time()
+            for _ in range(reps):
+                f(rows)
+            return time.time() - t0
+
+        f32_exact_s = timed(p32.predict_raw)
+        f32_device_s = timed(p32.predict_raw_device)
+        bf16_s = timed(p16.predict_raw)
+        err = float(np.abs(p16.predict_raw(rows)
+                           - p32.predict_raw(rows)).max())
+        out.update({
+            "bf16_throughput_ratio": round(
+                f32_exact_s / max(bf16_s, 1e-9), 3),
+            "bf16_rows_s": round(reps * len(rows) / max(bf16_s, 1e-9), 1),
+            "f32_rows_s": round(
+                reps * len(rows) / max(f32_exact_s, 1e-9), 1),
+            "f32_device_rows_s": round(
+                reps * len(rows) / max(f32_device_s, 1e-9), 1),
+            "bf16_vs_f32_device_ratio": round(
+                f32_device_s / max(bf16_s, 1e-9), 3),
+            "bf16_max_abs_err": err,
+            "bf16_accuracy_bound": float(p16.accuracy_bound),
+            "bf16_within_bound": bool(err <= p16.accuracy_bound),
+        })
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"fleet probe failed: {e}")
+        out["error"] = str(e)[-250:]
+    finally:
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+            srv.batcher.close()
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def run_ooc_child():
     """Out-of-core probe child (one per mode, so `ru_maxrss` is a clean
     per-mode peak): open the block store the parent built and train the
@@ -1468,6 +1621,10 @@ def main():
     if "dist_probe" in sys.argv:
         # standalone comms probe: `python bench.py dist_probe`
         print(json.dumps({"dist": dist_probe()}), flush=True)
+        return
+    if "fleet_probe" in sys.argv:
+        # standalone hot-swap/serving probe: `python bench.py fleet_probe`
+        print(json.dumps({"serving": fleet_probe()}), flush=True)
         return
     if "--child" in sys.argv:
         run_child()

@@ -1,0 +1,33 @@
+"""Fleet subsystem: model registry, hot-swap serving, and the
+drift-triggered train -> validate -> promote loop (docs/Fleet.md).
+
+PR 9 built the sensors (serving/drift.py PSI excursions, skew
+monitoring, dataset profiles) and PRs 2/3/7 built the training
+machinery (checkpoints, supervisor, block stores); this package is the
+actuator that closes the loop:
+
+- `registry.ModelRegistry` — versioned on-disk store of model +
+  profile sidecar + metadata with atomic publish (tmp+fsync+rename,
+  CRC manifest like the block store) and promote/rollback pointers;
+- `hotswap` — load + AOT-warm a challenger CompiledPredictor behind
+  the incumbent, flip atomically under the micro-batcher, and a
+  registry follower so a running server picks up promotions without
+  restart (`python -m lightgbm_tpu.serve model --registry DIR
+  --follow`);
+- `pipeline.FleetPipeline` — consumes psi_warn excursions from
+  /driftz, retrains on fresh data (riding PR-2 checkpoints and PR-7
+  block stores), validates the challenger against the incumbent on a
+  holdout, and promotes or quarantines via the registry, journaling
+  every transition (promote/reject/rollback) through the PR-5 journal;
+- `loadgen.LoadGenerator` — sustained-QPS /predict driver that records
+  p50/p99 under concurrency, including p99 *during* a hot-swap (the
+  bench's fleet_probe and `make verify-fleet` ride it).
+
+Import cost note: this package pulls in the serving stack (and so
+jax) only through `hotswap`; `registry`, `pipeline` policy logic and
+`loadgen` are importable jax-free.
+"""
+
+from .registry import ModelRegistry, RegistryError
+
+__all__ = ["ModelRegistry", "RegistryError"]

@@ -1,0 +1,220 @@
+"""`python -m lightgbm_tpu.fleet` — fleet CLI (docs/Fleet.md).
+
+Registry administration (jax-free, instant):
+
+    python -m lightgbm_tpu.fleet list     --registry DIR
+    python -m lightgbm_tpu.fleet publish  --registry DIR model.txt [--promote]
+    python -m lightgbm_tpu.fleet promote  --registry DIR --version N [--force]
+    python -m lightgbm_tpu.fleet rollback --registry DIR
+    python -m lightgbm_tpu.fleet verify   --registry DIR [--version N]
+
+The pipeline supervisor (drift -> retrain -> validate -> promote):
+
+    python -m lightgbm_tpu.fleet watch --registry DIR \
+        --serving-url http://127.0.0.1:8099 \
+        --fresh fresh.csv --holdout holdout.csv \
+        --param objective=binary --param num_leaves=31 \
+        [--interval 30] [--once] [--journal-dir DIR] [--min-improvement X]
+
+`watch` polls the serving fleet's /driftz; on a psi_warn excursion it
+retrains on the fresh CSV (label in column 0), validates against the
+incumbent on the holdout CSV, and promotes or quarantines through the
+registry — a serving process started with `--registry DIR --follow`
+hot-swaps to the promotion on its next poll.
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import time
+
+import numpy as np
+
+from ..utils.log import Log
+from .registry import ModelRegistry, RegistryError
+
+
+def _load_xy(path):
+    """CSV/TSV rows, label in column 0 (the CLI data convention)."""
+    first = open(path).readline()
+    sep = "\t" if "\t" in first else ","
+    data = np.genfromtxt(path, delimiter=sep, dtype=np.float64)
+    data = np.atleast_2d(data)
+    return data[:, 1:], data[:, 0]
+
+
+def _coerce(value):
+    for cast in (int, float):
+        try:
+            return cast(value)
+        except ValueError:
+            pass
+    if value.lower() in ("true", "false"):
+        return value.lower() == "true"
+    return value
+
+
+def _params(pairs):
+    out = {}
+    for pair in pairs or []:
+        if "=" not in pair:
+            raise SystemExit(f"--param wants key=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        out[k.strip()] = _coerce(v.strip())
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.fleet",
+        description="Model registry + drift-triggered retraining "
+                    "pipeline (docs/Fleet.md)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--registry", required=True,
+                       help="registry directory")
+        return p
+
+    common(sub.add_parser("list", help="registry summary"))
+    p = common(sub.add_parser("publish", help="publish a model file"))
+    p.add_argument("model")
+    p.add_argument("--profile", default=None,
+                   help="profile sidecar (default: <model>.profile.json "
+                        "when present)")
+    p.add_argument("--promote", action="store_true",
+                   help="promote the new version immediately")
+    p = common(sub.add_parser("promote", help="move CURRENT"))
+    p.add_argument("--version", type=int, required=True)
+    p.add_argument("--force", action="store_true",
+                   help="promote even a quarantined version")
+    p = common(sub.add_parser("rollback",
+                              help="restore the prior live version"))
+    p = common(sub.add_parser("verify", help="re-checksum versions"))
+    p.add_argument("--version", type=int, default=None)
+
+    p = common(sub.add_parser(
+        "watch", help="drift -> retrain -> validate -> promote loop"))
+    p.add_argument("--serving-url", required=True,
+                   help="base URL of the serving fleet (/driftz source)")
+    p.add_argument("--fresh", required=True,
+                   help="fresh training data CSV (label in column 0)")
+    p.add_argument("--holdout", required=True,
+                   help="validation holdout CSV (label in column 0)")
+    p.add_argument("--param", action="append", default=[],
+                   help="training param key=value (repeatable)")
+    p.add_argument("--num-boost-round", type=int, default=None,
+                   help="challenger boosting rounds (default: the "
+                        "num_iterations training param, else 100)")
+    p.add_argument("--interval", type=float, default=30.0,
+                   help="seconds between /driftz polls")
+    p.add_argument("--once", action="store_true",
+                   help="one poll+action pass, then exit (CI rungs)")
+    p.add_argument("--force", action="store_true",
+                   help="skip the drift gate: retrain now")
+    p.add_argument("--min-improvement", type=float, default=0.0,
+                   help="challenger must beat the incumbent's metric "
+                        "by at least this much to promote")
+    p.add_argument("--psi-warn", type=float, default=None,
+                   help="excursion threshold (default: mirror the "
+                        "serving monitor's)")
+    p.add_argument("--snapshot-dir", default=None,
+                   help="checkpoint directory: an interrupted retrain "
+                        "resumes from the newest snapshot")
+    p.add_argument("--journal-dir", default=None,
+                   help="PR-5 run journal directory for transition "
+                        "records")
+    args = ap.parse_args(argv)
+
+    registry = ModelRegistry(args.registry)
+    try:
+        if args.cmd == "list":
+            print(json.dumps(registry.describe(), indent=1, default=str))
+        elif args.cmd == "publish":
+            version = registry.publish(args.model,
+                                       profile_path=args.profile)
+            print(f"published v{version}")
+            if args.promote:
+                registry.promote(version, reason="cli publish --promote")
+                print(f"promoted v{version}")
+        elif args.cmd == "promote":
+            pointer = registry.promote(args.version, reason="cli",
+                                       force=args.force)
+            print(f"promoted v{pointer['version']} "
+                  f"(generation {pointer['generation']})")
+        elif args.cmd == "rollback":
+            pointer = registry.rollback(reason="cli")
+            print(f"rolled back to v{pointer['version']} "
+                  f"(generation {pointer['generation']})")
+        elif args.cmd == "verify":
+            versions = ([args.version] if args.version is not None
+                        else registry.versions())
+            for v in versions:
+                registry.verify(v)
+                print(f"v{v}: OK")
+            if not versions:
+                print("no published versions")
+        elif args.cmd == "watch":
+            return watch(args, registry)
+    except RegistryError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def watch(args, registry):
+    from .pipeline import DEFAULT_PSI_WARN, FleetPipeline, fetch_driftz
+    journal = None
+    if args.journal_dir:
+        from ..telemetry.journal import RunJournal
+        journal = RunJournal(args.journal_dir, source="fleet",
+                             meta={"source": "fleet"})
+    fresh_x, fresh_y = _load_xy(args.fresh)
+    hold_x, hold_y = _load_xy(args.holdout)
+    pipeline = FleetPipeline(
+        registry, _params(args.param),
+        psi_warn=(args.psi_warn if args.psi_warn is not None
+                  else DEFAULT_PSI_WARN),
+        min_improvement=args.min_improvement,
+        snapshot_dir=args.snapshot_dir, journal=journal)
+    Log.info("fleet watch: %s every %.0fs (registry %s)",
+             args.serving_url, args.interval, args.registry)
+    try:
+        while True:
+            try:
+                driftz = fetch_driftz(args.serving_url)
+            except (OSError, ValueError,
+                    http.client.HTTPException) as e:
+                # unreachable, a non-JSON body (a proxy's HTML error
+                # page) or a connection dropped mid-read
+                # (IncompleteRead/BadStatusLine) — the always-on
+                # supervisor must outlive a flaky serving endpoint
+                Log.warning("fleet watch: /driftz unreadable: %s", e)
+                driftz = None
+            if driftz is not None or args.force:
+                result = pipeline.run_once(
+                    driftz, fresh_x, fresh_y, hold_x, hold_y,
+                    num_boost_round=args.num_boost_round,
+                    force=args.force)
+                print("WATCH_RESULT " + json.dumps(result, default=str),
+                      flush=True)
+                if args.once:
+                    return 0
+                if args.force:
+                    args.force = False   # forced retrain happens once
+            elif args.once:
+                print('WATCH_RESULT {"action": "noop", '
+                      '"reason": "driftz unreachable"}', flush=True)
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if journal is not None:
+            journal.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,164 @@
+"""Load generator: sustained-QPS /predict traffic with timestamped
+latency capture — the instrument that prices a hot-swap.
+
+A swap's cost is invisible to whole-run percentiles (a 50 ms blip
+inside a 10 s run moves p99 by nothing), so every request keeps its
+START timestamp and `report()` slices the timeline into
+[steady | swap window | steady], emitting p50/p99 for the steady
+phases and p99 *inside* the marked window — `serving.p99_during_swap_ms`
+is the number BENCH_BASELINE.json tracks and `make verify-fleet`
+gates.
+
+Open-loop pacing: each worker owns every k-th tick of a global
+`start + i / qps` schedule and sleeps until its tick, so a slow
+response DELAYS later requests rather than silently lowering the
+offered rate (closed-loop generators hide exactly the stall a swap
+would cause). Errors never raise out of a worker: 5xx/timeouts are
+counted (`errors`) and the run continues — the assertion that a swap
+causes zero 5xx belongs to the caller.
+
+stdlib-only (threading + urllib), same floor as the serving stack.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+
+class LoadGenerator:
+    """Drive `POST <url>/predict` at `qps` requests/s with `workers`
+    concurrent threads for `duration_s`. Rows per request cycle
+    through `row_batches` (a list of (n, F) arrays), so responses stay
+    checkable against per-model expectations."""
+
+    def __init__(self, url, row_batches, qps=100.0, workers=4,
+                 duration_s=5.0, timeout_s=30.0, path="/predict"):
+        self.url = url.rstrip("/") + path
+        self.bodies = [json.dumps({"rows": np.asarray(b).tolist()})
+                       .encode() for b in row_batches]
+        self.qps = float(qps)
+        self.workers = int(workers)
+        self.duration_s = float(duration_s)
+        self.timeout_s = float(timeout_s)
+        self.samples = []      # (t_start_rel, latency_s, ok)
+        self.responses = []    # (t_start_rel, predictions) when kept
+        self.errors = []       # repr strings, bounded
+        self.keep_responses = False
+        self._lock = threading.Lock()
+        self._marks = {}       # name -> (t0_rel, t1_rel)
+        self.t0 = None
+
+    # ------------------------------------------------------------- marks
+    def mark_start(self, name):
+        with self._lock:
+            self._marks[name] = [time.monotonic() - self.t0, None]
+
+    def mark_end(self, name):
+        with self._lock:
+            if name in self._marks:
+                self._marks[name][1] = time.monotonic() - self.t0
+
+    # --------------------------------------------------------------- run
+    def _worker(self, wid):
+        n_total = int(self.qps * self.duration_s)
+        i = wid
+        while i < n_total:
+            sched = self.t0 + i / self.qps
+            delay = sched - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            body = self.bodies[i % len(self.bodies)]
+            t_req = time.monotonic()
+            ok, preds = True, None
+            try:
+                req = urllib.request.Request(
+                    self.url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as r:
+                    out = json.loads(r.read())
+                if self.keep_responses:
+                    preds = out.get("predictions")
+            except Exception as e:   # count, never raise (module doc)
+                ok = False
+                with self._lock:
+                    if len(self.errors) < 50:
+                        self.errors.append(repr(e))
+            lat = time.monotonic() - t_req
+            with self._lock:
+                self.samples.append((t_req - self.t0, lat, ok))
+                if preds is not None:
+                    self.responses.append((t_req - self.t0, preds))
+            i += self.workers
+
+    def run(self, background=False):
+        """Fire the schedule. `background=True` returns immediately
+        with the worker threads running (the caller swaps mid-run and
+        then `join()`s)."""
+        self.t0 = time.monotonic()
+        self._threads = [threading.Thread(target=self._worker, args=(w,),
+                                          daemon=True)
+                         for w in range(self.workers)]
+        for t in self._threads:
+            t.start()
+        if not background:
+            self.join()
+        return self
+
+    def join(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._threads:
+            t.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+
+    # ------------------------------------------------------------ report
+    @staticmethod
+    def _pct(lats, p):
+        """Nearest-rank percentile in ms (telemetry/registry.py
+        nearest_rank — the same convention as the /metricz ring, so
+        the gated p99-during-swap and serving p99 stay comparable)."""
+        if not lats:
+            return 0.0
+        from ..telemetry.registry import nearest_rank
+        return round(nearest_rank(sorted(lats), p) * 1e3, 3)
+
+    def report(self, swap_mark="swap"):
+        """Aggregate: steady p50/p99 (samples OUTSIDE the swap mark),
+        p99 during the mark, offered/achieved rate, error count."""
+        with self._lock:
+            samples = list(self.samples)
+            mark = self._marks.get(swap_mark)
+        lat_all = [lt for _, lt, ok in samples if ok]
+        out = {"requests": len(samples),
+               "errors": sum(1 for _, _, ok in samples if not ok),
+               "offered_qps": round(self.qps, 1)}
+        if samples:
+            span = max(t for t, _, _ in samples) - min(
+                t for t, _, _ in samples)
+            out["achieved_qps"] = round(
+                len(samples) / max(span, 1e-9), 1)
+        if mark and mark[1] is not None:
+            t0, t1 = mark
+            # a sample belongs to the swap window if its LIFETIME
+            # overlaps it — a request in flight when the window opens
+            # absorbs the stall and must not inflate the steady bucket
+            # (which would let the gate pass trivially)
+            during = [lt for t, lt, ok in samples
+                      if ok and t <= t1 and t + lt >= t0]
+            steady = [lt for t, lt, ok in samples
+                      if ok and (t > t1 or t + lt < t0)]
+            out.update({
+                "steady_p50_ms": self._pct(steady, 50),
+                "steady_p99_ms": self._pct(steady, 99),
+                "p99_during_swap_ms": self._pct(during, 99),
+                "swap_window_s": round(t1 - t0, 3),
+                "swap_window_requests": len(during),
+            })
+        else:
+            out.update({"steady_p50_ms": self._pct(lat_all, 50),
+                        "steady_p99_ms": self._pct(lat_all, 99)})
+        return out

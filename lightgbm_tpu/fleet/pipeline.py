@@ -1,0 +1,287 @@
+"""Fleet pipeline: psi_warn excursion -> retrain -> validate -> promote.
+
+The policy loop that turns the PR-9 sensors into actions
+(docs/Fleet.md):
+
+1. **Sense** — `drift_excursion` reads a `/driftz` document (the
+   serving drift monitor's snapshot, fetched over HTTP by the CLI or
+   passed in-process by tests) and decides whether the fleet is
+   drifting: any active psi_warn warning, or psi_max over the
+   threshold with enough sampled rows to mean it.
+2. **Retrain** — `retrain` trains a challenger on fresh data with the
+   SAME params as the incumbent (lineage is recorded, not implied).
+   Rides the existing machinery: `snapshot_dir` arms the PR-2
+   checkpoint callback (an interrupted retrain resumes instead of
+   restarting), and `out_of_core=true` in the params streams the fresh
+   data through a PR-7 block store. The model + profile sidecar land
+   in a work directory, not the registry — publishing is a separate,
+   deliberate step.
+3. **Validate** — `validate` scores challenger and incumbent on the
+   SAME holdout through the host f64 reference path (the serving skew
+   monitor's ground truth) and compares the objective's natural metric
+   (AUC for binary — higher is better; L2 otherwise — lower is
+   better).
+4. **Act** — `run_once` publishes the challenger and either promotes
+   it (better by at least `min_improvement`) or quarantines it, via
+   the registry — which journals the `promote`/`reject` record. A
+   serving fleet following the registry picks the promotion up on its
+   next poll; `rollback` is one registry call away.
+
+jax only loads inside `retrain`/`validate` — registry admin flows
+(`python -m lightgbm_tpu.fleet list/promote/rollback`) stay light.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+
+from ..utils.log import Log
+from .registry import ModelRegistry
+
+# mirrors serving/drift.py DEFAULT_PSI_WARN (importing the serving
+# package here would pull jax into the registry-admin CLI paths;
+# tests/test_fleet.py pins the two constants equal)
+DEFAULT_PSI_WARN = 0.2
+DEFAULT_MIN_IMPROVEMENT = 0.0
+
+
+def auc_score(labels, scores):
+    """Binary AUC via the rank-sum (Mann-Whitney) identity with
+    average ranks on ties — matches the reference AUC metric's
+    semantics without needing a constructed dataset."""
+    y = np.asarray(labels, np.float64).reshape(-1)
+    s = np.asarray(scores, np.float64).reshape(-1)
+    pos = y > 0
+    n_pos = int(pos.sum())
+    n_neg = len(y) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(len(s), np.float64)
+    sorted_s = s[order]
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and sorted_s[j + 1] == sorted_s[i]:
+            j += 1
+        ranks[order[i:j + 1]] = 0.5 * (i + j) + 1.0   # average 1-based
+        i = j + 1
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0)
+                 / (n_pos * n_neg))
+
+
+def fetch_driftz(url, timeout=30):
+    """GET `<serving url>/driftz` -> the drift snapshot dict."""
+    with urllib.request.urlopen(url.rstrip("/") + "/driftz",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _host_scores(model_path, x):
+    """Holdout raw scores through the host f64 reference path (device
+    predict forced off — validation must not inherit serving-precision
+    error). Rides the serving skew monitor's reference scorer: same
+    forced-host routing AND the same input-width canonicalization, so
+    a holdout narrower/wider than the model's feature count validates
+    instead of crashing the supervisor."""
+    from ..serving.drift import host_reference_scorer
+    return np.asarray(host_reference_scorer(model_path)("raw", x))
+
+
+class FleetPipeline:
+    """One drift-triggered train->validate->promote policy instance
+    (module docstring). `registry` may be a path or a ModelRegistry;
+    an attached journal receives every transition record."""
+
+    def __init__(self, registry, train_params, workdir=None,
+                 psi_warn=DEFAULT_PSI_WARN,
+                 min_improvement=DEFAULT_MIN_IMPROVEMENT,
+                 snapshot_dir=None, snapshot_period=5, journal=None):
+        self.registry = (registry if isinstance(registry, ModelRegistry)
+                         else ModelRegistry(registry, journal=journal))
+        if journal is not None:
+            self.registry.journal = journal
+        self.journal = journal
+        self.train_params = dict(train_params)
+        self.workdir = os.fspath(workdir) if workdir \
+            else os.path.join(self.registry.directory, "work")
+        os.makedirs(self.workdir, exist_ok=True)
+        self.psi_warn = float(psi_warn)
+        self.min_improvement = float(min_improvement)
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_period = int(snapshot_period)
+        objective = str(self.train_params.get("objective", "regression"))
+        if objective in ("binary", "lambdarank", "rank_xendcg"):
+            self.metric_name, self.higher_better = "auc", True
+        elif objective in ("multiclass", "multiclassova", "softmax"):
+            # softmax logloss over the raw class scores — a real
+            # multiclass comparison, not class-0 L2
+            self.metric_name, self.higher_better = "multi_logloss", False
+        else:
+            self.metric_name, self.higher_better = "l2", False
+
+    # -------------------------------------------------------------- sense
+    def drift_excursion(self, driftz):
+        """Decide whether a /driftz document is an actionable
+        excursion. Returns {feature, psi, rows_sampled} (worst
+        offender) or None. Requires the monitor's own min_psi_rows
+        bar — acting on a cold window would retrain on noise."""
+        if not driftz or not driftz.get("enabled", True):
+            return None
+        rows = int(driftz.get("rows_sampled", 0))
+        if rows < int(driftz.get("min_psi_rows", 0)):
+            return None
+        warnings = driftz.get("warnings") or []
+        psi_max = float(driftz.get("psi_max", 0.0))
+        if not warnings and psi_max < self.psi_warn:
+            return None
+        worst, worst_psi = "", psi_max
+        for name, rec in (driftz.get("features") or {}).items():
+            if float(rec.get("psi", 0.0)) >= worst_psi:
+                worst, worst_psi = name, float(rec["psi"])
+        if not worst and warnings:
+            worst = str(warnings[-1].get("feature", ""))
+            worst_psi = float(warnings[-1].get("psi", psi_max))
+        return {"feature": worst, "psi": round(worst_psi, 4),
+                "rows_sampled": rows}
+
+    # ------------------------------------------------------------ retrain
+    def retrain(self, x, y, num_boost_round=None, tag=None):
+        """Train a challenger on fresh data and save model + profile
+        sidecar into the work directory. Returns the model path.
+        `snapshot_dir` arms checkpointing AND resume: a pipeline
+        process killed mid-retrain continues from the newest snapshot
+        on the next call. A COMPLETED retrain leaves a RETRAIN_DONE
+        marker next to its snapshots; the next retrain sees it and
+        starts fresh (clearing the stale snapshots) instead of
+        resuming a finished run — resuming one would train zero new
+        rounds and ignore the new fresh data."""
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu import callback
+        params = dict(self.train_params)
+        rounds = params.pop("num_iterations", None)
+        if num_boost_round is not None:
+            rounds = num_boost_round
+        rounds = int(rounds or 100)
+        callbacks, resume_from, done_marker = [], None, None
+        if self.snapshot_dir:
+            os.makedirs(self.snapshot_dir, exist_ok=True)
+            done_marker = os.path.join(self.snapshot_dir, "RETRAIN_DONE")
+            if os.path.exists(done_marker):
+                for name in os.listdir(self.snapshot_dir):
+                    if name.endswith(".ckpt"):
+                        os.unlink(os.path.join(self.snapshot_dir, name))
+                os.unlink(done_marker)
+            callbacks.append(callback.checkpoint(
+                self.snapshot_dir, period=max(1, self.snapshot_period)))
+            resume_from = self.snapshot_dir
+        t0 = time.monotonic()
+        booster = lgb.train(params,
+                            lgb.Dataset(np.asarray(x), np.asarray(y),
+                                        params=params),
+                            num_boost_round=rounds,
+                            callbacks=callbacks or None,
+                            resume_from=resume_from,
+                            verbose_eval=False)
+        tag = tag or time.strftime("%Y%m%d_%H%M%S")
+        model_path = os.path.join(self.workdir, f"challenger_{tag}.txt")
+        booster.save_model(model_path)
+        if done_marker is not None:
+            from ..utils.checkpoint import atomic_write_text
+            atomic_write_text(done_marker, json.dumps(
+                {"ts": time.time(), "model": model_path,
+                 "rounds": rounds}) + "\n")
+        Log.info("fleet: retrained challenger %s (%d rows, %d rounds, "
+                 "%.2fs)", model_path, len(np.asarray(y)), rounds,
+                 time.monotonic() - t0)
+        return model_path
+
+    # ----------------------------------------------------------- validate
+    def metric(self, labels, raw_scores):
+        raw = np.asarray(raw_scores, np.float64)
+        if self.metric_name == "auc":
+            return auc_score(labels, raw[:, 0])
+        if self.metric_name == "multi_logloss":
+            y = np.asarray(labels, np.int64).reshape(-1)
+            z = raw - raw.max(axis=1, keepdims=True)
+            logp = z - np.log(np.exp(z).sum(axis=1, keepdims=True))
+            return float(-logp[np.arange(len(y)),
+                               np.clip(y, 0, raw.shape[1] - 1)].mean())
+        err = np.asarray(labels, np.float64).reshape(-1) - raw[:, 0]
+        return float(np.mean(err * err))
+
+    def validate(self, challenger_path, holdout_x, holdout_y,
+                 incumbent_path=None):
+        """Score challenger (and the incumbent, when one is live) on
+        the holdout. Returns {metric_name, challenger, incumbent,
+        better} — `better` is True when there is no incumbent (first
+        model wins by default)."""
+        chall = self.metric(holdout_y,
+                            _host_scores(challenger_path, holdout_x))
+        if incumbent_path is None:
+            cur = self.registry.current_version()
+            incumbent_path = (self.registry.model_path(cur)
+                              if cur is not None else None)
+        out = {"metric_name": self.metric_name,
+               "challenger": round(chall, 6), "incumbent": None,
+               "better": True}
+        if incumbent_path and os.path.exists(incumbent_path):
+            inc = self.metric(holdout_y,
+                              _host_scores(incumbent_path, holdout_x))
+            out["incumbent"] = round(inc, 6)
+            delta = (chall - inc) if self.higher_better else (inc - chall)
+            out["better"] = delta >= self.min_improvement
+        return out
+
+    # ---------------------------------------------------------------- act
+    def run_once(self, driftz, fresh_x, fresh_y, holdout_x, holdout_y,
+                 num_boost_round=None, force=False):
+        """One full policy pass. Returns an action dict:
+        {action: noop|promote|reject, ...}. `force=True` skips the
+        drift gate (operator-initiated retrain)."""
+        excursion = None
+        if not force:
+            excursion = self.drift_excursion(driftz)
+            if excursion is None:
+                return {"action": "noop", "reason": "no drift excursion"}
+        if self.journal is not None:
+            self.journal.event(
+                "note", msg="fleet retrain trigger: "
+                + json.dumps(excursion or {"forced": True}))
+        parent = self.registry.current_version()
+        challenger_path = self.retrain(fresh_x, fresh_y,
+                                       num_boost_round=num_boost_round)
+        verdict = self.validate(challenger_path, holdout_x, holdout_y)
+        metadata = {
+            "metric_name": verdict["metric_name"],
+            "metric": verdict["challenger"],
+            "incumbent_metric": verdict["incumbent"],
+            "parent_version": parent,
+            "train_rows": int(len(np.asarray(fresh_y))),
+            "trigger": excursion or {"forced": True},
+            "params": {k: v for k, v in self.train_params.items()
+                       if isinstance(v, (str, int, float, bool))},
+        }
+        version = self.registry.publish(challenger_path,
+                                        metadata=metadata)
+        fields = dict(metric=float(verdict["challenger"]),
+                      metric_name=str(verdict["metric_name"]))
+        if verdict["incumbent"] is not None:
+            fields["incumbent_metric"] = float(verdict["incumbent"])
+        if verdict["better"]:
+            self.registry.promote(
+                version, reason=f"{verdict['metric_name']} "
+                f"{verdict['challenger']} vs {verdict['incumbent']}",
+                **fields)
+            return {"action": "promote", "version": version,
+                    "excursion": excursion, **verdict}
+        self.registry.quarantine(
+            version, reason=f"{verdict['metric_name']} "
+            f"{verdict['challenger']} not better than "
+            f"{verdict['incumbent']} (+{self.min_improvement})",
+            **fields)
+        return {"action": "reject", "version": version,
+                "excursion": excursion, **verdict}

@@ -9,7 +9,7 @@ users never pay for the serving stack.
 from .batcher import MicroBatcher
 from .compiled_model import CompiledPredictor
 from .metrics import ServingMetrics
-from .server import make_server
+from .server import build_monitors, drain, make_server, swap_model
 
 __all__ = ["CompiledPredictor", "MicroBatcher", "ServingMetrics",
-           "make_server"]
+           "build_monitors", "drain", "make_server", "swap_model"]

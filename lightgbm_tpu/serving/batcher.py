@@ -41,6 +41,7 @@ class MicroBatcher:
         self._cond = threading.Condition(self._lock)
         self._queue = []          # [(kind, rows, future, t_enqueue)]
         self._closed = False
+        self._busy = False        # worker is mid-dispatch (quiesce check)
         self._worker = threading.Thread(target=self._run,
                                         name="micro-batcher", daemon=True)
         self._worker.start()
@@ -63,7 +64,7 @@ class MicroBatcher:
         # t_dispatch/t_done stamped by the worker BEFORE it resolves
         # the future, so a woken waiter always sees all three
         fut.t_enqueue = time.monotonic()
-        fut.t_dispatch = fut.t_done = None
+        fut.t_dispatch = fut.t_done = fut.scored_by = None
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
@@ -79,6 +80,22 @@ class MicroBatcher:
     def queue_depth(self):
         with self._lock:
             return len(self._queue)
+
+    def quiescent(self):
+        """True when nothing is queued AND the worker is not
+        mid-dispatch (the `/quiescez` admin check)."""
+        with self._lock:
+            return not self._queue and not self._busy
+
+    def swap_predictor(self, predictor):
+        """Atomically replace the predictor (hot-swap, fleet/hotswap).
+        The worker snapshots the predictor ONCE per coalesced batch, so
+        every batch — including one already queued — is scored entirely
+        by a single model version; requests enqueued after this call
+        ride the new one. Returns the retired predictor."""
+        with self._cond:
+            old, self.predictor = self.predictor, predictor
+        return old
 
     def close(self, timeout=5.0):
         """Drain and stop the worker. Pending futures still resolve."""
@@ -119,6 +136,7 @@ class MicroBatcher:
                 else:
                     rest.append(item)
             self._queue = rest
+            self._busy = True   # cleared by _run after futures resolve
             return kind, batch
 
     def _run(self):
@@ -127,18 +145,31 @@ class MicroBatcher:
             if got is None:
                 return
             kind, batch = got
+            # ONE predictor snapshot per batch: a concurrent hot-swap
+            # (swap_predictor) lands between batches, never inside one —
+            # a coalesced dispatch is scored entirely by one model
+            pred = self.predictor
             t_dispatch = time.monotonic()
             try:
                 # inside the try: ANY failure (even a concat shape
                 # mismatch) must fail this batch's futures, never kill
                 # the single worker thread
-                rows = np.concatenate([r for r, _ in batch], axis=0)
+                parts = [r for r, _ in batch]
+                if len({r.shape[1] for r in parts}) > 1:
+                    # widths were canonicalized at submit time against
+                    # the THEN-current predictor; a swap to a different
+                    # feature width can strand mixed widths in one
+                    # batch — re-canonicalize against the snapshot
+                    canon = getattr(pred, "_canon", None)
+                    if canon is not None:
+                        parts = [canon(r) for r in parts]
+                rows = np.concatenate(parts, axis=0)
                 if kind == "leaf":
-                    out = self.predictor.predict_leaf_index(rows)
+                    out = pred.predict_leaf_index(rows)
                 elif kind == "raw":
-                    out = self.predictor.predict_raw(rows)
+                    out = pred.predict_raw(rows)
                 else:
-                    out = self.predictor.predict(rows)
+                    out = pred.predict(rows)
             except Exception as e:
                 # errors are counted per REQUEST by whoever consumes the
                 # futures (the HTTP handler) — counting the batch here
@@ -146,7 +177,10 @@ class MicroBatcher:
                 t_done = time.monotonic()
                 for _, fut in batch:
                     fut.t_dispatch, fut.t_done = t_dispatch, t_done
+                    fut.scored_by = pred
                     fut.set_exception(e)
+                with self._lock:
+                    self._busy = False
                 continue
             t_done = time.monotonic()
             if self.metrics is not None:
@@ -154,5 +188,12 @@ class MicroBatcher:
             s = 0
             for r, fut in batch:
                 fut.t_dispatch, fut.t_done = t_dispatch, t_done
+                # which model scored this request: the handler's
+                # monitor intake checks it against the monitors' owner
+                # so a hot-swap mid-request cannot shadow-score one
+                # model's output against another's reference
+                fut.scored_by = pred
                 fut.set_result(out[s:s + r.shape[0]])
                 s += r.shape[0]
+            with self._lock:
+                self._busy = False

@@ -33,6 +33,7 @@ on the MXU) for throughput-bound callers that tolerate ~1e-6.
 """
 
 import functools
+import os
 import time
 
 import jax
@@ -46,16 +47,32 @@ from ..utils import common
 from ..utils.log import Log
 
 DEFAULT_MAX_BATCH_ROWS = 4096
+# serving_precision values (docs/Serving.md): `f32` is the exact
+# contract (device f32 traversal + host f64 reduction, bit-identical
+# to the reference); `bf16` keeps the traversal DECISIONS exact (f32
+# compare against f32-safe thresholds) but gathers leaf values and
+# runs the class reduction in bfloat16 on device — the Booster
+# accelerator result (arXiv:2011.02022): ensemble throughput lives in
+# node layout + reduced value precision, and the value stage is where
+# precision can drop without moving a single traversal decision. The
+# bf16 path ships a PINNED accuracy bound (`accuracy_bound`, computed
+# from the frozen leaf values at load) that the skew monitor adopts
+# as its tolerance, so monitoring stays armed and quiet by
+# construction.
+SERVING_PRECISIONS = ("f32", "bf16")
 
 
-@functools.partial(jax.jit, static_argnums=(7,))
+@jax.jit
 def _leaf_kernel(xb, sf, thr, cat, lc, rc, node0, depth):
-    """(B, F) f32 rows -> (B, T) int32 leaf indices."""
+    """(B, F) f32 rows -> (B, T) int32 leaf indices. `depth` is a
+    TRACED operand (fori_loop handles dynamic trip counts), so two
+    model generations of different depth share one executable — depth
+    must never be a recompile trigger across a hot-swap."""
     node = device_traverse(xb, sf, thr, cat, lc, rc, node0, depth)
     return (~node).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(9,))
+@jax.jit
 def _raw_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot, depth):
     """(B, F) f32 rows -> (B, K) f32 raw class sums (MXU reduction)."""
     node = device_traverse(xb, sf, thr, cat, lc, rc, node0, depth)
@@ -64,7 +81,7 @@ def _raw_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot, depth):
     return vals @ cls_onehot                                # (B, K)
 
 
-@functools.partial(jax.jit, static_argnums=(9, 10))
+@functools.partial(jax.jit, static_argnums=(10,))
 def _transformed_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot,
                         depth, sigmoid):
     """(B, F) f32 rows -> (B, K) f32 transformed predictions
@@ -79,6 +96,94 @@ def _transformed_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot,
     return raw
 
 
+@jax.jit
+def _raw16_kernel(xb, sf, thr, cat, lc, rc, lv16, node0, onehot16, depth):
+    """bf16 value stage: EXACT f32 traversal (identical decisions to
+    the f32 kernels — thr stays the f32-safe cast), then a bfloat16
+    leaf-value gather and a bf16 x bf16 class reduction accumulated in
+    f32 on the MXU. Node arrays may ride the compact int16 layout
+    (serving_precision docstring at module top)."""
+    node = device_traverse(xb, sf, thr, cat, lc, rc, node0, depth)
+    t_idx = jnp.arange(sf.shape[0])
+    vals = lv16[t_idx[None, :], ~node]                      # (B, T) bf16
+    return jax.lax.dot(vals, onehot16,
+                       preferred_element_type=jnp.float32)  # (B, K) f32
+
+
+@functools.partial(jax.jit, static_argnums=(10,))
+def _transformed16_kernel(xb, sf, thr, cat, lc, rc, lv16, node0, onehot16,
+                          depth, sigmoid):
+    """bf16 raw stage + the f32 transform (sigmoid/softmax run on the
+    f32 accumulator output, so the transform adds no bf16 error)."""
+    raw = _raw16_kernel(xb, sf, thr, cat, lc, rc, lv16, node0, onehot16,
+                        depth)
+    if sigmoid > 0 and onehot16.shape[1] == 1:
+        return 1.0 / (1.0 + jnp.exp(-2.0 * sigmoid * raw))
+    if onehot16.shape[1] > 1:
+        return jax.nn.softmax(raw, axis=1)
+    return raw
+
+
+def _bf16_round(arr):
+    """Host-side f64 view of an array after a round-trip through
+    bfloat16 (the rounding the bf16 leaf gather applies on device)."""
+    return np.asarray(jnp.asarray(arr, jnp.bfloat16).astype(jnp.float32),
+                      np.float64)
+
+
+def _compact_int(arr, lo=-32768, hi=32767):
+    """int16 copy when every value fits (the compact node layout —
+    half the traversal gather bytes), int32 otherwise."""
+    a = np.asarray(arr)
+    if a.size and (a.min() < lo or a.max() > hi):
+        return a.astype(np.int32)
+    return a.astype(np.int16)
+
+
+# Shape-stable padding (hot-swap support, docs/Fleet.md): the tree
+# count pads to a multiple of TREE_PAD, so two model GENERATIONS of
+# the same training recipe freeze to IDENTICAL kernel shapes — a
+# challenger loaded behind the incumbent warms from the in-process jit
+# cache (or the persistent disk cache) instead of recompiling, which
+# is what keeps p99 flat through a hot-swap. (Depth is a TRACED kernel
+# operand, never a compile key — see _leaf_kernel.) Padded trees are a
+# frozen root leaf with value 0 and a zero one-hot row: they
+# contribute nothing to any class sum, and the leaf-index surface
+# slices back to the real tree count. Cost: <= (TREE_PAD-1) extra tree
+# lanes of gather work.
+TREE_PAD = 16
+# the node axis (max nodes/leaves per tree) pads too: two generations
+# with the same num_leaves knob can still grow different ACTUAL leaf
+# counts, and a one-column difference would force a full recompile
+NODE_PAD = 32
+
+
+def _pad_up(n, multiple):
+    n = max(int(n), 1)
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def _pad_rows(arr, pad, fill=0):
+    """Append `pad` rows of `fill` along axis 0 (dtype preserved)."""
+    a = np.asarray(arr)
+    if pad <= 0:
+        return a
+    return np.concatenate(
+        [a, np.full((pad,) + a.shape[1:], fill, a.dtype)])
+
+
+def _pad_grid(arr, row_pad, col_multiple=NODE_PAD, fill=0):
+    """Row padding + column padding to a multiple (the (T, nodes) SoA
+    arrays; padded node slots are unreachable — no child edge points
+    at them)."""
+    a = _pad_rows(arr, row_pad, fill)
+    cols = _pad_up(a.shape[1], col_multiple) - a.shape[1]
+    if cols <= 0:
+        return a
+    return np.concatenate(
+        [a, np.full((a.shape[0], cols), fill, a.dtype)], axis=1)
+
+
 class CompiledPredictor:
     """A frozen, pre-compiled view of one trained model.
 
@@ -87,10 +192,21 @@ class CompiledPredictor:
     later training on the source booster never changes served results.
     """
 
+    # set by from_model_file (sidecar auto-discovery); None when frozen
+    # from a live booster
+    model_path = None
+    profile_path = None
+    profile = None
+
     def __init__(self, booster, num_iteration=-1,
                  max_batch_rows=DEFAULT_MAX_BATCH_ROWS, row_buckets=None,
-                 warmup=True, warm_device_kernels=False):
+                 warmup=True, warm_device_kernels=False,
+                 serving_precision="f32"):
         setup_compilation_cache(getattr(booster, "config", None))
+        if serving_precision not in SERVING_PRECISIONS:
+            raise ValueError(
+                f"serving_precision must be one of {SERVING_PRECISIONS}, "
+                f"got {serving_precision!r}")
         n_used = booster._num_used_models(num_iteration)
         self.num_class = max(int(booster.num_class), 1)
         self.sigmoid = float(booster.sigmoid)
@@ -98,12 +214,15 @@ class CompiledPredictor:
         self.num_trees = n_used
         self.feature_names = list(getattr(booster, "feature_names", []))
         self.max_batch_rows = int(max_batch_rows)
+        self.serving_precision = serving_precision
+        self.accuracy_bound = 0.0
         self.buckets = tuple(sorted(set(
             int(b) for b in (row_buckets or _default_buckets(
                 self.max_batch_rows)))))
         self.stats = {"warmup_s": 0.0, "compile_cache_hits": 0,
                       "warm_dispatches": 0, "cold_dispatches": 0,
-                      "buckets": list(self.buckets)}
+                      "buckets": list(self.buckets),
+                      "serving_precision": serving_precision}
         self._warmed = set()
         if n_used == 0:
             self.depth = 0
@@ -111,24 +230,86 @@ class CompiledPredictor:
         sf, thr, dt, lc, rc, lv, has_split, depth = \
             booster._stacked_model_arrays(n_used)
         self.depth = int(depth)
+        # shape-stable padding (TREE_PAD comment above): the kernel
+        # shapes depend on the PADDED counts only; depth rides as a
+        # traced operand
+        t_pad = _pad_up(n_used, TREE_PAD)
+        self._depth_arg = np.int32(self.depth)
+        pad = t_pad - n_used
         # frozen copies: the booster's cache arrays mutate as training
-        # continues; the served model must not
+        # continues; the served model must not. The exact host-reduce
+        # arrays stay UNPADDED (the (N, T) leaf gather slices back to
+        # real trees); the device SoA arrays pad.
         self._lv64 = np.array(lv, dtype=np.float64)             # (T, L)
         onehot = (np.arange(n_used)[:, None] % self.num_class
                   == np.arange(self.num_class)[None, :])
         self._onehot64 = onehot.astype(np.float64)              # (T, K)
+        sf_p = _pad_grid(np.array(sf), pad)
+        thr_p = _pad_grid(np.array(thr), pad)
+        dt_p = _pad_grid(np.array(dt), pad)
+        lc_p = _pad_grid(np.array(lc), pad)
+        rc_p = _pad_grid(np.array(rc), pad)
+        lv_p = _pad_grid(np.array(lv), pad)          # zero leaf values
+        onehot_p = _pad_rows(onehot, pad)            # zero one-hot rows
+        node0_np = np.concatenate(
+            [np.where(has_split, 0, ~0).astype(np.int32),
+             np.full(pad, ~0, np.int32)])            # padded: root leaf
+        thr32 = f32_safe_thresholds(thr_p, dt_p)
         self._dev = (
-            jnp.asarray(np.array(sf)),
-            jnp.asarray(f32_safe_thresholds(thr, dt), jnp.float32),
-            jnp.asarray(np.array(dt) == Tree.CATEGORICAL),
-            jnp.asarray(np.array(lc)),
-            jnp.asarray(np.array(rc)),
-            jnp.asarray(np.where(has_split, 0, ~0).astype(np.int32)),
+            jnp.asarray(sf_p),
+            jnp.asarray(thr32, jnp.float32),
+            jnp.asarray(dt_p == Tree.CATEGORICAL),
+            jnp.asarray(lc_p),
+            jnp.asarray(rc_p),
+            jnp.asarray(node0_np),
         )
-        self._lv32 = jnp.asarray(lv, jnp.float32)
-        self._onehot32 = jnp.asarray(onehot.astype(np.float32))
+        # the f32 device value arrays back only the off-endpoint
+        # `_device` throughput variants — built lazily on first use so
+        # a serving fleet (exact path: host f64 reduce; bf16 path: the
+        # bf16 arrays) never pays a second value buffer per model
+        self._lv_np = lv_p
+        self._onehot_np = onehot_p.astype(np.float32)
+        self._lv32 = self._onehot32 = None
+        if serving_precision == "bf16":
+            # compact node layout (int16 where node/feature ids fit —
+            # at serving tree sizes they always do) + bf16 value arrays;
+            # thresholds stay the f32-safe cast so every traversal
+            # decision is IDENTICAL to the exact path
+            self._dev16 = (
+                jnp.asarray(_compact_int(sf_p)),
+                self._dev[1],
+                self._dev[2],
+                jnp.asarray(_compact_int(lc_p)),
+                jnp.asarray(_compact_int(rc_p)),
+                jnp.asarray(_compact_int(node0_np)),
+            )
+            self._lv16 = jnp.asarray(lv_p, jnp.bfloat16)
+            self._onehot16 = jnp.asarray(onehot_p.astype(np.float32),
+                                         jnp.bfloat16)   # 0/1: exact
+            self.accuracy_bound = self._pin_accuracy_bound(n_used)
         if warmup:
             self.warm_up(device_kernels=warm_device_kernels)
+
+    def _pin_accuracy_bound(self, n_used):
+        """Worst-case |bf16 output - exact f64 output| over ANY input,
+        derived from the frozen leaf values: traversal decisions are
+        exact, so the only error sources are the bf16 rounding of each
+        gathered leaf value (bounded per tree by its worst-rounded
+        leaf) and the f32 accumulation of the class reduction. The
+        transform can amplify raw error (binary: dp/draw <= sigmoid/2),
+        so the pinned bound covers raw AND transformed outputs. A 2x
+        margin absorbs rounding-mode asymmetries. The serving skew
+        monitor adopts this as its tolerance (server.build_monitors),
+        keeping shadow scoring armed and quiet by construction."""
+        err_t = np.abs(self._lv64 - _bf16_round(self._lv64)).max(axis=1)
+        raw_bound = float((err_t @ self._onehot64).max())
+        mags = float((np.abs(self._lv64).max(axis=1)
+                      @ self._onehot64).max())
+        slack = mags * n_used * float(np.finfo(np.float32).eps)
+        factor = 1.0
+        if self.sigmoid > 0 and self.num_class == 1:
+            factor = max(1.0, self.sigmoid / 2.0)
+        return 2.0 * factor * (raw_bound + slack)
 
     # ---------------------------------------------------------- constructors
     @classmethod
@@ -140,11 +321,28 @@ class CompiledPredictor:
 
     @classmethod
     def from_model_file(cls, path, num_iteration=-1, **kw):
-        """Load the text model format and freeze it."""
+        """Load the text model format and freeze it. Auto-discovers the
+        `<model>.profile.json` dataset-profile sidecar (io/profile.py)
+        when one sits next to the model: `predictor.profile` then
+        carries the training baseline the drift monitor needs, so
+        serving gets drift monitoring without an explicit --profile
+        flag (and a registry hot-swap rebuilds monitors against the
+        NEW model's own baseline)."""
         booster = create_boosting("gbdt", path)
         with open(path) as f:
             booster.load_model_from_string(f.read())
-        return cls(booster, num_iteration=num_iteration, **kw)
+        inst = cls(booster, num_iteration=num_iteration, **kw)
+        inst.model_path = os.fspath(path)
+        from ..io.profile import DatasetProfile, model_profile_path
+        sidecar = model_profile_path(path)
+        if os.path.exists(sidecar):
+            try:
+                inst.profile = DatasetProfile.load(sidecar)
+                inst.profile_path = sidecar
+            except (OSError, ValueError) as e:
+                Log.warning("ignoring unreadable profile sidecar %s: %s",
+                            sidecar, e)
+        return inst
 
     # --------------------------------------------------------------- warmup
     def warm_up(self, device_kernels=False):
@@ -160,6 +358,7 @@ class CompiledPredictor:
         t0 = time.time()
         hits0 = compile_cache_hits()
         from ..telemetry.ledger import LEDGER
+        bf16 = self.serving_precision == "bf16"
         for b in self.buckets:
             xb = jnp.zeros((b, self.num_features), jnp.float32)
             # the compile ledger attributes each bucket's lowering(s):
@@ -167,6 +366,12 @@ class CompiledPredictor:
             with LEDGER.label(f"serving_bucket_{b}"):
                 jax.block_until_ready(self._dispatch_leaf(xb))
                 self._warmed.add(("leaf", b))
+                if bf16:
+                    # predict/predict_raw dispatch the bf16 kernels —
+                    # every endpoint's (kernel, bucket) pair pre-warms
+                    jax.block_until_ready(self._dispatch_raw16(xb))
+                    jax.block_until_ready(self._dispatch_transformed16(xb))
+                    self._warmed.update((("raw16", b), ("tr16", b)))
                 if device_kernels:
                     jax.block_until_ready(self._dispatch_raw32(xb))
                     jax.block_until_ready(self._dispatch_transformed32(xb))
@@ -182,18 +387,38 @@ class CompiledPredictor:
     # ------------------------------------------------------------ dispatch
     def _dispatch_leaf(self, xb):
         sf, thr, cat, lc, rc, node0 = self._dev
-        return _leaf_kernel(xb, sf, thr, cat, lc, rc, node0, self.depth)
+        return _leaf_kernel(xb, sf, thr, cat, lc, rc, node0,
+                            self._depth_arg)
+
+    def _f32_values(self):
+        if self._lv32 is None:
+            self._lv32 = jnp.asarray(self._lv_np, jnp.float32)
+            self._onehot32 = jnp.asarray(self._onehot_np)
+        return self._lv32, self._onehot32
 
     def _dispatch_raw32(self, xb):
         sf, thr, cat, lc, rc, node0 = self._dev
-        return _raw_kernel(xb, sf, thr, cat, lc, rc, self._lv32, node0,
-                           self._onehot32, self.depth)
+        lv32, onehot32 = self._f32_values()
+        return _raw_kernel(xb, sf, thr, cat, lc, rc, lv32, node0,
+                           onehot32, self._depth_arg)
 
     def _dispatch_transformed32(self, xb):
         sf, thr, cat, lc, rc, node0 = self._dev
-        return _transformed_kernel(xb, sf, thr, cat, lc, rc, self._lv32,
-                                   node0, self._onehot32, self.depth,
-                                   self.sigmoid)
+        lv32, onehot32 = self._f32_values()
+        return _transformed_kernel(xb, sf, thr, cat, lc, rc, lv32,
+                                   node0, onehot32,
+                                   self._depth_arg, self.sigmoid)
+
+    def _dispatch_raw16(self, xb):
+        sf, thr, cat, lc, rc, node0 = self._dev16
+        return _raw16_kernel(xb, sf, thr, cat, lc, rc, self._lv16, node0,
+                             self._onehot16, self._depth_arg)
+
+    def _dispatch_transformed16(self, xb):
+        sf, thr, cat, lc, rc, node0 = self._dev16
+        return _transformed16_kernel(xb, sf, thr, cat, lc, rc, self._lv16,
+                                     node0, self._onehot16,
+                                     self._depth_arg, self.sigmoid)
 
     def _canon(self, x):
         """(N, num_features) f32 view of arbitrary row input: width is
@@ -245,23 +470,39 @@ class CompiledPredictor:
         x = self._canon(x)
         if self.num_trees == 0 or x.shape[0] == 0:
             return np.zeros((x.shape[0], self.num_trees), dtype=np.int32)
-        return self._blocks(x, self._dispatch_leaf, "leaf")
+        # slice the shape-stable tree padding back off (TREE_PAD)
+        return self._blocks(x, self._dispatch_leaf,
+                            "leaf")[:, :self.num_trees]
 
     def predict_raw(self, x):
-        """(N, K) f64 raw scores. Device traversal + host f64 reduction:
-        matches GBDT.predict_raw's host path exactly (module
-        docstring)."""
+        """(N, K) f64 raw scores. Exact precision: device traversal +
+        host f64 reduction, matching GBDT.predict_raw's host path
+        bit-for-bit (module docstring). `serving_precision="bf16"`:
+        all-device bf16 value stage, within `accuracy_bound` of the
+        exact path by construction."""
         x = self._canon(x)
         n = x.shape[0]
         if self.num_trees == 0 or n == 0:
             return np.zeros((n, self.num_class))
-        leaves = self._blocks(x, self._dispatch_leaf, "leaf")  # (N, T)
+        if self.serving_precision == "bf16":
+            return self._blocks(x, self._dispatch_raw16,
+                                "raw16").astype(np.float64)
+        leaves = self._blocks(x, self._dispatch_leaf,
+                              "leaf")[:, :self.num_trees]     # (N, T)
         vals = self._lv64[np.arange(self.num_trees)[None, :], leaves]
         return vals @ self._onehot64                         # (N, K) f64
 
     def predict(self, x):
         """(N, K) f64 transformed predictions (gbdt.py predict:
-        binary sigmoid / multiclass softmax / raw passthrough)."""
+        binary sigmoid / multiclass softmax / raw passthrough). The
+        bf16 precision transforms on device from the f32 accumulator
+        output (`accuracy_bound` covers the transformed value too)."""
+        if self.serving_precision == "bf16" and self.num_trees > 0:
+            x = self._canon(x)
+            if x.shape[0] == 0:
+                return np.zeros((0, self.num_class))
+            return self._blocks(x, self._dispatch_transformed16,
+                                "tr16").astype(np.float64)
         raw = self.predict_raw(x)
         if self.sigmoid > 0 and self.num_class == 1:
             return 1.0 / (1.0 + np.exp(-2.0 * self.sigmoid * raw))
@@ -299,6 +540,10 @@ class CompiledPredictor:
             "sigmoid": self.sigmoid,
             "max_batch_rows": self.max_batch_rows,
             "buckets": list(self.buckets),
+            "serving_precision": self.serving_precision,
+            "accuracy_bound": self.accuracy_bound,
+            "model_path": self.model_path,
+            "has_profile": self.profile is not None,
         }
 
 

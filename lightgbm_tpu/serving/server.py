@@ -7,15 +7,30 @@ micro-batching queue (serving/batcher.py) and serves:
 - POST /predict          transformed predictions (sigmoid/softmax)
 - POST /predict_raw      raw scores
 - POST /predict_leaf     leaf indices
-- GET  /healthz          liveness + model card
+- GET  /healthz          liveness + model card (+ served model version)
 - GET  /metricz          request/row/batch counters, batch occupancy,
                          queue depth, p50/p95/p99 latency, warmup +
-                         compile-cache stats, drift/skew gauges
+                         compile-cache stats, drift/skew gauges,
+                         model version + hot-swap counters
 - GET  /driftz           the drift & skew monitors' full view: rolling
                          per-feature PSI vs the training profile,
                          prediction-distribution histogram, shadow-
                          scoring skew counters (serving/drift.py;
                          requires a <model>.profile.json baseline)
+- GET  /quiescez         admin drain check: 200 when no request is in
+                         flight and the batcher is idle, 503 otherwise
+                         (clean hot-flips and rolling restarts wait on
+                         this)
+
+Hot-swap: `swap_model` flips the served model atomically under the
+batcher (one predictor snapshot per coalesced batch — a response is
+never scored by two model versions), and `--registry DIR --follow`
+polls a fleet ModelRegistry so promotions/rollbacks land in a running
+server without restart (lightgbm_tpu/fleet/, docs/Fleet.md). SIGTERM
+drains: connections keep being ACCEPTED but new POSTs bounce with a
+retryable 503 while in-flight requests finish (bounded by
+--drain-timeout-s); only then does the listener close and the process
+exit.
 
 Request body: JSON `{"rows": [[...], ...]}` (or `{"row": [...]}` for a
 single row), or `text/csv` — one comma/tab-separated row per line.
@@ -34,6 +49,7 @@ import json
 import re
 import signal
 import sys
+import threading
 import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -89,10 +105,30 @@ class ServingHandler(BaseHTTPRequestHandler):
     # set by make_server():
     batcher = None
     metrics = None
-    predictor = None
     slow_request_ms = DEFAULT_SLOW_REQUEST_MS
-    drift = None     # serving/drift.py DriftMonitor (or None)
-    skew = None      # serving/drift.py SkewMonitor (or None)
+    # (owner_predictor, drift, skew) — THE monitor reference, swapped
+    # as ONE tuple assignment: _observe_quality reads it atomically
+    # and only feeds the monitors results their OWN model scored, so a
+    # hot-swap mid-request cannot pair one model's output with
+    # another's baseline/reference (a false, unretractable skew_warn
+    # otherwise). The read-only endpoints (/driftz, /metricz) view the
+    # same tuple through the drift/skew properties below.
+    monitor_state = (None, None, None)
+
+    @property
+    def drift(self):
+        return self.monitor_state[1]   # serving/drift.py DriftMonitor
+
+    @property
+    def skew(self):
+        return self.monitor_state[2]   # serving/drift.py SkewMonitor
+
+    @property
+    def predictor(self):
+        # the batcher's reference is THE served model — reading it here
+        # keeps /healthz + /metricz consistent with what dispatches
+        # score, including across a hot-swap (swap_model)
+        return self.batcher.predictor
 
     def log_message(self, fmt, *args):
         # the structured access-log record (one per request, with id +
@@ -129,7 +165,8 @@ class ServingHandler(BaseHTTPRequestHandler):
     def _metricz_snapshot(self):
         snap = self.metrics.snapshot()
         snap["queue_depth"] = self.batcher.queue_depth()
-        stats = self.predictor.stats
+        predictor = self.predictor
+        stats = predictor.stats
         snap["warmup_s"] = stats["warmup_s"]
         snap["compile_cache_hits"] = stats["compile_cache_hits"]
         # True when AOT warmup was served by the persistent compile
@@ -138,6 +175,18 @@ class ServingHandler(BaseHTTPRequestHandler):
         snap["warm_dispatches"] = stats["warm_dispatches"]
         snap["cold_dispatches"] = stats["cold_dispatches"]
         snap["buckets"] = stats["buckets"]
+        # fleet surface: which model generation is serving, how it got
+        # here (docs/Fleet.md)
+        srv = self.server
+        snap["model_version"] = getattr(srv, "model_version", None)
+        snap["swap_count"] = int(getattr(srv, "swap_count", 0))
+        snap["serving_precision"] = getattr(predictor,
+                                            "serving_precision", "f32")
+        snap["accuracy_bound"] = float(getattr(predictor,
+                                               "accuracy_bound", 0.0))
+        snap["in_flight"] = int(getattr(srv, "inflight").count
+                                if hasattr(srv, "inflight") else 0)
+        snap["draining"] = bool(getattr(srv, "draining", False))
         # drift/skew scalar gauges ride the same page (full view on
         # /driftz); absent monitors contribute nothing
         if self.drift is not None:
@@ -170,7 +219,23 @@ class ServingHandler(BaseHTTPRequestHandler):
         fmt = (parse_qs(parts.query).get("format") or [""])[0]
         if parts.path.startswith("/healthz"):
             self._reply(200, {"status": "ok",
-                              "model": self.predictor.describe()})
+                              "model": self.predictor.describe(),
+                              "model_version": getattr(
+                                  self.server, "model_version", None)})
+        elif parts.path.startswith("/quiescez"):
+            # admin drain check: a clean flip/restart waits for 200
+            srv = self.server
+            in_flight = (srv.inflight.count
+                         if hasattr(srv, "inflight") else 0)
+            queued = self.batcher.queue_depth()
+            idle = self.batcher.quiescent()
+            quiescent = in_flight == 0 and queued == 0 and idle
+            self._reply(200 if quiescent else 503, {
+                "quiescent": quiescent,
+                "draining": bool(getattr(srv, "draining", False)),
+                "in_flight": int(in_flight),
+                "queue_depth": int(queued),
+                "batcher_idle": bool(idle)})
         elif parts.path.startswith("/driftz"):
             out = {"enabled": self.drift is not None
                    or self.skew is not None}
@@ -193,6 +258,35 @@ class ServingHandler(BaseHTTPRequestHandler):
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
+        srv = self.server
+        gauge = getattr(srv, "inflight", None)
+        # the gauge increments BEFORE the draining check: the reverse
+        # order would let drain() observe a false quiescent between a
+        # handler passing the check and registering itself, tearing
+        # the batcher down under a live request
+        if gauge is not None:
+            gauge.inc()
+        try:
+            if getattr(srv, "draining", False):
+                # shutting down: refuse new work with a retryable
+                # status so the drain converges (in-flight requests
+                # still finish). Bounced requests stay auditable:
+                # they count as errors and land in the access log
+                req_id = self._request_id()
+                self.close_connection = True
+                self.metrics.record_error()
+                self._reply(503, {"error": "draining: server is "
+                                           "shutting down",
+                                  "request_id": req_id},
+                            {"X-Request-Id": req_id})
+                self._access_log(req_id, 0, 503, None)
+                return
+            self._handle_post()
+        finally:
+            if gauge is not None:
+                gauge.dec()
+
+    def _handle_post(self):
         req_id = self._request_id()
         id_hdr = {"X-Request-Id": req_id}
         # drain the body BEFORE any reply: on an HTTP/1.1 keep-alive
@@ -278,51 +372,183 @@ class ServingHandler(BaseHTTPRequestHandler):
         self._access_log(req_id, rows.shape[0], 200, timing)
         # drift/skew intake AFTER the reply: sampled monitoring must
         # never add to the latency the client (or /metricz) sees
-        self._observe_quality(kind, rows, out)
+        self._observe_quality(kind, rows, out, fut)
 
-    def _observe_quality(self, kind, rows, out):
+    def _observe_quality(self, kind, rows, out, fut=None):
         """Feed the drift monitor (sampled row histograms + the
         prediction distribution) and the skew monitor (sampled host
         f64 shadow scoring). Never raises — a monitor defect must not
-        poison the keep-alive connection."""
-        if self.drift is None and self.skew is None:
+        poison the keep-alive connection. A request whose batch was
+        scored by a DIFFERENT predictor than the monitors' owner (a
+        hot-swap landed mid-request) is skipped: sampled monitoring
+        can drop one sample, a false skew alarm cannot be retracted."""
+        owner, drift, skew = self.monitor_state   # ONE atomic read
+        if drift is None and skew is None:
+            return
+        scored_by = getattr(fut, "scored_by", None)
+        if scored_by is not None and scored_by is not owner:
             return
         try:
-            if self.drift is not None:
+            if drift is not None:
                 # the monitor reduces multiclass outputs to the
                 # winning-class confidence at flush — pass the batcher
                 # output through untouched (request path stays cheap)
-                self.drift.observe(
+                drift.observe(
                     rows, predictions=out if kind == "predict" else None)
-            if self.skew is not None and kind in ("predict", "raw"):
-                self.skew.observe(rows, out, kind)
+            if skew is not None and kind in ("predict", "raw"):
+                skew.observe(rows, out, kind)
         except Exception as e:
             Log.warning("drift/skew monitor failed: %s", e)
+
+
+class _InflightGauge:
+    """Count of POST requests currently inside a handler thread (the
+    /quiescez drain check's second leg — the batcher queue only sees a
+    request between submit and future-resolve)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def inc(self):
+        with self._lock:
+            self._count += 1
+
+    def dec(self):
+        with self._lock:
+            self._count -= 1
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+
+def build_monitors(predictor, drift_sample_rate=0.0, psi_warn=None,
+                   profile_bins=None, skew_sample_rate=0.0,
+                   skew_warn=None, profile_path=None):
+    """Construct the (drift, skew) monitor pair for one predictor from
+    the serve CLI's monitor knobs. The drift baseline comes from the
+    predictor's auto-discovered profile sidecar
+    (CompiledPredictor.from_model_file) unless `profile_path`
+    overrides; the skew reference loads from `predictor.model_path`,
+    and its tolerance is widened to the predictor's pinned
+    `accuracy_bound` so a reduced-precision model keeps shadow scoring
+    armed AND quiet (compiled_model.py). Either monitor is None when
+    its inputs are off/absent. Hot-swaps rebuild both against the new
+    model (fleet/hotswap.py)."""
+    from ..io.profile import DEFAULT_PROFILE_BINS, DatasetProfile
+    from .drift import (DEFAULT_PSI_WARN, DEFAULT_SKEW_WARN, SKEW_TOL,
+                        DriftMonitor, SkewMonitor, host_reference_scorer)
+    drift = skew = None
+    if drift_sample_rate and drift_sample_rate > 0:
+        profile = predictor.profile
+        if profile_path:
+            try:
+                profile = DatasetProfile.load(profile_path)
+            except (OSError, ValueError) as e:
+                # a stale --profile path degrades to drift-off with a
+                # warning (the pre-fleet behavior), never a boot crash
+                Log.warning("cannot load profile %s (%s); falling back "
+                            "to the model's own sidecar", profile_path, e)
+                profile = predictor.profile
+        if profile is not None:
+            pred_range = ((0.0, 1.0)
+                          if predictor.sigmoid > 0
+                          or predictor.num_class > 1 else None)
+            drift = DriftMonitor(
+                profile, sample_rate=drift_sample_rate,
+                psi_warn=(DEFAULT_PSI_WARN if psi_warn is None
+                          else psi_warn),
+                profile_bins=(DEFAULT_PROFILE_BINS if profile_bins is None
+                              else profile_bins),
+                pred_range=pred_range)
+        else:
+            Log.warning("drift monitor off: predictor has no profile "
+                        "baseline (train with a build that writes "
+                        "<model>.profile.json, or pass --profile)")
+    if skew_sample_rate and skew_sample_rate > 0:
+        if predictor.model_path:
+            skew = SkewMonitor(
+                host_reference_scorer(predictor.model_path),
+                sample_rate=skew_sample_rate,
+                skew_warn=(DEFAULT_SKEW_WARN if skew_warn is None
+                           else skew_warn),
+                tol=max(SKEW_TOL,
+                        float(getattr(predictor, "accuracy_bound", 0.0))))
+        else:
+            Log.warning("skew monitor off: predictor has no model file "
+                        "to load the host reference from")
+    return drift, skew
+
+
+def swap_model(srv, predictor, drift=None, skew=None, version=None):
+    """Atomically flip a live server to a new (already warmed)
+    predictor. Order matters: the batcher flips FIRST (dispatch
+    provenance — one model per coalesced batch, fleet/hotswap.py),
+    then the monitor/metadata surfaces follow; the monitor_owner tag
+    keeps in-flight requests scored by the OTHER model out of the new
+    monitors (ServingHandler._observe_quality). Returns the retired
+    predictor."""
+    old = srv.batcher.swap_predictor(predictor)
+    handler = srv.RequestHandlerClass
+    handler.monitor_state = (predictor, drift, skew)
+    srv.model_version = version
+    srv.swap_count = int(getattr(srv, "swap_count", 0)) + 1
+    return old
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose `predictor` delegates to the batcher —
+    the batcher's reference is THE served model (one source of truth),
+    so a caller flipping via `batcher.swap_predictor` directly can
+    never desync the server-level view."""
+
+    @property
+    def predictor(self):
+        return self.batcher.predictor
 
 
 def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
                 max_batch_rows=None,
                 slow_request_ms=DEFAULT_SLOW_REQUEST_MS,
-                drift=None, skew=None):
+                drift=None, skew=None, model_version=None,
+                monitor_settings=None):
     """Wire predictor + batcher + metrics (+ optional drift/skew
     monitors, serving/drift.py) into a ThreadingHTTPServer (not yet
-    serving — call serve_forever, or use it from tests)."""
+    serving — call serve_forever, or use it from tests).
+    `monitor_settings` (the build_monitors kwargs) are remembered on
+    the server so a hot-swap can rebuild monitors for the new model."""
     metrics = ServingMetrics()
     batcher = MicroBatcher(predictor,
                            max_batch_rows=max_batch_rows,
                            max_wait_ms=max_wait_ms, metrics=metrics)
     handler = type("BoundServingHandler", (ServingHandler,),
                    {"batcher": batcher, "metrics": metrics,
-                    "predictor": predictor,
                     "slow_request_ms": float(slow_request_ms or 0.0),
-                    "drift": drift, "skew": skew})
-    srv = ThreadingHTTPServer((host, port), handler)
+                    "monitor_state": (predictor, drift, skew)})
+    srv = ServingHTTPServer((host, port), handler)
     srv.batcher = batcher
     srv.metrics = metrics
-    srv.predictor = predictor
-    srv.drift = drift
-    srv.skew = skew
+    srv.model_version = model_version
+    srv.swap_count = 0
+    srv.inflight = _InflightGauge()
+    srv.draining = False
+    srv.monitor_settings = dict(monitor_settings or {})
     return srv
+
+
+def drain(srv, timeout_s=30.0, poll_s=0.05):
+    """Wait until no POST is in flight and the batcher is idle (or the
+    timeout passes). Callers set `srv.draining = True` first so new
+    work bounces with 503 and the wait converges. Returns True when
+    fully quiesced."""
+    deadline = time.monotonic() + float(timeout_s)
+    while time.monotonic() < deadline:
+        if srv.inflight.count == 0 and srv.batcher.quiescent():
+            return True
+        time.sleep(poll_s)
+    return srv.inflight.count == 0 and srv.batcher.quiescent()
 
 
 def main(argv=None):
@@ -330,9 +556,32 @@ def main(argv=None):
         prog="python -m lightgbm_tpu.serve",
         description="Serve a trained model over HTTP with micro-batching "
                     "(docs/Serving.md)")
-    ap.add_argument("model", help="model file (text format)")
+    ap.add_argument("model", nargs="?", default=None,
+                    help="model file (text format); optional when "
+                         "--registry points at a registry with a live "
+                         "version")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8099)
+    ap.add_argument("--registry", default="",
+                    help="fleet model-registry directory (docs/Fleet.md):"
+                         " serve its CURRENT version (the positional "
+                         "model is a fallback while the registry is "
+                         "empty)")
+    ap.add_argument("--follow", action="store_true",
+                    help="poll the registry and hot-swap to promotions/"
+                         "rollbacks without restart (requires "
+                         "--registry)")
+    ap.add_argument("--poll-s", type=float, default=2.0,
+                    help="registry poll interval for --follow")
+    ap.add_argument("--serving-precision", default="f32",
+                    choices=("f32", "bf16"),
+                    help="f32 = exact serving contract; bf16 = reduced-"
+                         "precision value stage with a pinned accuracy "
+                         "bound the skew monitor adopts "
+                         "(docs/Serving.md)")
+    ap.add_argument("--drain-timeout-s", type=float, default=30.0,
+                    help="SIGTERM drain: how long to wait for in-flight "
+                         "requests before exiting")
     ap.add_argument("--max-batch-rows", type=int,
                     default=DEFAULT_MAX_BATCH_ROWS,
                     help="largest coalesced dispatch; also the largest "
@@ -348,7 +597,7 @@ def main(argv=None):
                     help="serve only the first N iterations of the model")
     from .drift import (DEFAULT_DRIFT_SAMPLE_RATE, DEFAULT_PSI_WARN,
                         DEFAULT_SKEW_SAMPLE_RATE, DEFAULT_SKEW_WARN)
-    from ..io.profile import DEFAULT_PROFILE_BINS, model_profile_path
+    from ..io.profile import DEFAULT_PROFILE_BINS
     ap.add_argument("--profile", default="",
                     help="training dataset profile JSON (default: "
                          "<model>.profile.json when it exists); the "
@@ -374,71 +623,104 @@ def main(argv=None):
                     help="diverging-row count that triggers the "
                          "structured skew_warn log (mirrors skew_warn)")
     args = ap.parse_args(argv)
+    if args.follow and not args.registry:
+        ap.error("--follow requires --registry")
 
     t0 = time.time()
+    registry = None
+    model_path, model_version = args.model, None
+    if args.registry:
+        from ..fleet.registry import ModelRegistry
+        registry = ModelRegistry(args.registry)
+        cur = registry.current()
+        if cur is not None:
+            model_version = int(cur["version"])
+            # same CRC discipline as every follower hot-swap: bit rot
+            # in the live version must fail the boot, not get served
+            registry.verify(model_version)
+            model_path = registry.model_path(model_version)
+            Log.info("serving registry %s CURRENT v%d (manifest "
+                     "verified)", args.registry, model_version)
+    if not model_path:
+        ap.error("no model: pass a model file or --registry with a "
+                 "promoted version")
     predictor = CompiledPredictor.from_model_file(
-        args.model, num_iteration=args.num_iteration,
-        max_batch_rows=args.max_batch_rows)
-    drift = skew = None
-    if args.drift_sample_rate > 0:
-        import os
-        from ..io.profile import DatasetProfile
-        from .drift import DriftMonitor
-        profile_path = args.profile or model_profile_path(args.model)
-        if os.path.exists(profile_path):
-            profile = DatasetProfile.load(profile_path)
-            # transformed binary/multiclass predictions live in [0, 1]
-            pred_range = ((0.0, 1.0)
-                          if predictor.sigmoid > 0
-                          or predictor.num_class > 1 else None)
-            drift = DriftMonitor(profile,
-                                 sample_rate=args.drift_sample_rate,
-                                 psi_warn=args.psi_warn,
-                                 profile_bins=args.profile_bins,
-                                 pred_range=pred_range)
-            Log.info("drift monitor on: %d profiled features, sample "
-                     "rate %.3f, psi_warn %.2f (%s)",
-                     profile.num_features, args.drift_sample_rate,
-                     args.psi_warn, profile_path)
-        else:
-            Log.warning("drift monitor off: no training profile at %s "
-                        "(train with a build that writes "
-                        "<model>.profile.json, or pass --profile)",
-                        profile_path)
-    if args.skew_sample_rate > 0:
-        from .drift import SkewMonitor, host_reference_scorer
-        skew = SkewMonitor(host_reference_scorer(args.model),
-                           sample_rate=args.skew_sample_rate,
-                           skew_warn=args.skew_warn)
+        model_path, num_iteration=args.num_iteration,
+        max_batch_rows=args.max_batch_rows,
+        serving_precision=args.serving_precision)
+    monitor_settings = dict(
+        drift_sample_rate=args.drift_sample_rate,
+        psi_warn=args.psi_warn, profile_bins=args.profile_bins,
+        skew_sample_rate=args.skew_sample_rate,
+        skew_warn=args.skew_warn)
+    drift, skew = build_monitors(predictor, profile_path=args.profile,
+                                 **monitor_settings)
+    if drift is not None:
+        Log.info("drift monitor on: %d profiled features, sample rate "
+                 "%.3f, psi_warn %.2f", drift.profile.num_features,
+                 args.drift_sample_rate, args.psi_warn)
+    if skew is not None:
         Log.info("skew monitor on: sample rate %.3f, warn at %d "
-                 "diverging row(s)", args.skew_sample_rate,
-                 args.skew_warn)
+                 "diverging row(s), tol %.3g", args.skew_sample_rate,
+                 args.skew_warn, skew.tol)
     srv = make_server(predictor, host=args.host, port=args.port,
                       max_wait_ms=args.max_wait_ms,
                       max_batch_rows=args.max_batch_rows,
                       slow_request_ms=args.slow_request_ms,
-                      drift=drift, skew=skew)
-    Log.info("serving %s on http://%s:%d (%d trees, load+warm %.2fs, "
-             "%d compile-cache hits)", args.model, args.host, args.port,
-             predictor.num_trees, time.time() - t0,
-             predictor.stats["compile_cache_hits"])
+                      drift=drift, skew=skew,
+                      model_version=model_version,
+                      monitor_settings=monitor_settings)
+    # the swap path re-applies this knob to every challenger
+    # (fleet/hotswap.py HotSwapper)
+    srv.num_iteration = args.num_iteration
+    follower = None
+    if args.follow:
+        from ..fleet.hotswap import attach_follower
+        follower = attach_follower(srv, registry, poll_s=args.poll_s,
+                                   serving_precision=args.serving_precision)
+        Log.info("following registry %s every %.1fs", args.registry,
+                 args.poll_s)
+    Log.info("serving %s on http://%s:%d (%d trees, %s, load+warm "
+             "%.2fs, %d compile-cache hits)", model_path, args.host,
+             args.port, predictor.num_trees, args.serving_precision,
+             time.time() - t0, predictor.stats["compile_cache_hits"])
     # the driver-facing readiness line: tests and orchestrators wait
     # for this exact prefix on stdout before sending traffic
     print(f"SERVING http://{args.host}:{srv.server_address[1]}",
           flush=True)
 
+    stop = threading.Event()
+
     def shut(signum, frame):
-        raise KeyboardInterrupt
+        stop.set()
 
     signal.signal(signal.SIGTERM, shut)
+    serve_thread = threading.Thread(target=srv.serve_forever,
+                                    daemon=True)
+    serve_thread.start()
     try:
-        srv.serve_forever()
+        while not stop.wait(0.2):
+            pass
     except KeyboardInterrupt:
         pass
     finally:
+        # graceful drain: KEEP accepting while draining (so brand-new
+        # connections get the retryable 503 from a handler instead of
+        # hanging on an un-accepted socket), let in-flight requests
+        # finish, THEN stop the listener and tear down
+        srv.draining = True
+        if follower is not None:
+            follower.stop()
+        drained = drain(srv, timeout_s=args.drain_timeout_s)
+        srv.shutdown()
+        serve_thread.join(timeout=10)
         srv.server_close()
         srv.batcher.close()
-        Log.info("serving stopped")
+        Log.structured("Info", "drain", drained=bool(drained),
+                       in_flight=srv.inflight.count,
+                       queue_depth=srv.batcher.queue_depth())
+        Log.info("serving stopped (%s)",
+                 "drained" if drained else "drain timeout")
     return 0
 
 

@@ -40,7 +40,8 @@ TID_SUPERVISOR = 1
 TID_SPAN_BASE = 16   # span recording threads map to 16, 17, ...
 
 _INSTANT_EVENTS = {"run_start", "run_end", "resume", "truncate",
-                   "abort", "restart", "note", "config", "mesh"}
+                   "abort", "restart", "note", "config", "mesh",
+                   "promote", "reject", "rollback"}
 
 
 def collect_records(source):
@@ -249,6 +250,10 @@ def build_trace(records):
                 # mesh (re-)derivation marker: across an elastic shrink
                 # the shards/f_loc args change between two of these
                 name = f"mesh {rec.get('shards')} shard(s)"
+            elif event in ("promote", "reject", "rollback"):
+                # fleet registry transitions: model generations as
+                # markers on the same timeline as training progress
+                name = f"{event} v{rec.get('version')}"
             b.instant(rank, tid, name, ts, args or None)
         # unknown events are skipped: the exporter must keep working on
         # journals from a newer schema

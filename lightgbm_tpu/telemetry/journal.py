@@ -105,6 +105,22 @@ SCHEMA = {
                           "learner": str, "source": str}},
     "run_end": {"required": {"iterations": int},
                 "optional": {"train_s": float, "source": str}},
+    # fleet registry transitions (fleet/registry.py): one record per
+    # pointer move / quarantine, with the validation metrics that drove
+    # the decision — the Perfetto export renders them as instant
+    # markers on the fleet timeline (docs/Fleet.md)
+    "promote": {"required": {"version": int},
+                "optional": {"from_version": int, "generation": int,
+                             "reason": str, "metric": float,
+                             "metric_name": str,
+                             "incumbent_metric": float, "source": str}},
+    "reject": {"required": {"version": int},
+               "optional": {"reason": str, "metric": float,
+                            "metric_name": str,
+                            "incumbent_metric": float, "source": str}},
+    "rollback": {"required": {"version": int},
+                 "optional": {"from_version": int, "generation": int,
+                              "reason": str, "source": str}},
     # device-memory watermarks sampled at iteration/block boundaries
     # (telemetry/ledger.py sample_memory; device_* absent on backends
     # without allocator stats — this image's CPU jax returns None)

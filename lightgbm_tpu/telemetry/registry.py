@@ -23,6 +23,17 @@ import numpy as np
 DEFAULT_RING = 4096
 
 
+def nearest_rank(sorted_values, p):
+    """Nearest-rank percentile of an ascending-sorted sequence:
+    ceil(n*p/100) - 1 (int() would bias one rank high — p50 of 2
+    samples must be the lower one, and p99 of 100 samples rank 98, not
+    the absolute max). THE percentile convention every surface shares:
+    the serving /metricz latency ring and the fleet load generator's
+    gated p99-during-swap must never diverge."""
+    n = len(sorted_values)
+    return float(sorted_values[max(0, -(-n * p // 100) - 1)])
+
+
 class Counter:
     """Monotonic counter (int/float adds)."""
 
@@ -89,17 +100,15 @@ class Histogram:
         return min(self._n, len(self._ring))
 
     def percentiles(self, pcts=(50, 95, 99)):
-        """{p: value} over the ring's recorded window; empty dict before
-        the first observation. Nearest-rank: ceil(n*p/100) - 1 (int()
-        would bias one rank high — p50 of 2 samples must be the lower
-        one, and p99 of 100 samples rank 98, not the absolute max)."""
+        """{p: value} over the ring's recorded window; empty dict
+        before the first observation (nearest-rank — see
+        `nearest_rank`)."""
         with self._lock:
             n = min(self._n, len(self._ring))
             if n == 0:
                 return {}
             window = np.sort(self._ring[:n])
-        return {p: float(window[max(0, -(-n * p // 100) - 1)])
-                for p in pcts}
+        return {p: nearest_rank(window, p) for p in pcts}
 
     def summary(self):
         pct = self.percentiles()

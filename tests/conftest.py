@@ -34,6 +34,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs with `-m 'not slow'`; the slow mark carries the
+    # longer acceptance rungs (make verify-fleet runs them)
+    config.addinivalue_line("markers",
+                            "slow: long acceptance rungs, skipped by "
+                            "the tier-1 `-m 'not slow'` filter")
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.RandomState(42)

@@ -301,7 +301,119 @@ def check_dist():
     return ok and good
 
 
+def check_fleet():
+    """Fleet/hot-swap acceptance guard (`make verify-fleet`; the
+    bench's fleet_probe in gate form): the sustained-QPS CPU serving
+    rung must (1) finish the run with ZERO 5xx and ZERO cold dispatches
+    across the mid-run hot-swap, (2) keep p99 DURING the swap within
+    VERIFY_FLEET_SWAP_FACTOR (default 2.0) of steady-state p99 and
+    within VERIFY_FLEET_TOL (default 50%) of the committed
+    serving_p99_during_swap_ms baseline, and (3) show the bf16
+    serving_precision path within its pinned accuracy bound AND at
+    least VERIFY_FLEET_MIN_BF16_RATIO (default 1.2) times the f32
+    serving default's throughput."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import bench
+    res = bench.fleet_probe(
+        timeout_s=int(os.environ.get("VERIFY_FLEET_TIMEOUT", "480")))
+    if "error" in res:
+        print(f"verify-fleet: probe failed: {res['error']}")
+        return False
+    ok = True
+    print(f"verify-fleet: {res['requests']} requests @ "
+          f"{res['achieved_qps']:.0f} qps, steady p50/p99 "
+          f"{res['steady_p50_ms']:.1f}/{res['steady_p99_ms']:.1f} ms, "
+          f"swap {res['swap_s'] * 1e3:.0f} ms (warmup "
+          f"{res['swap_warmup_s'] * 1e3:.0f} ms)")
+    # sample floor: a wedged server makes every latency gate pass
+    # vacuously (0 samples -> p99 0.0), so thin runs FAIL loudly
+    min_requests = int(os.environ.get("VERIFY_FLEET_MIN_REQUESTS",
+                                      "500"))
+    min_window = int(os.environ.get("VERIFY_FLEET_MIN_SWAP_SAMPLES",
+                                    "20"))
+    if (res["requests"] < min_requests
+            or res["swap_window_requests"] < min_window):
+        print(f"verify-fleet: only {res['requests']} request(s), "
+              f"{res['swap_window_requests']} in the swap window "
+              f"(floors {min_requests}/{min_window}) -> "
+              "INSUFFICIENT SAMPLES")
+        ok = False
+    if res["errors"]:
+        print(f"verify-fleet: {res['errors']} failed request(s) over "
+              "the whole run (steady phases or swap window) -> "
+              "REQUEST FAILURES UNDER LOAD")
+        ok = False
+    else:
+        print("verify-fleet: zero failed requests across the run "
+              "(incl. the hot-swap) -> OK")
+    if res["cold_dispatches"]:
+        print(f"verify-fleet: {res['cold_dispatches']} cold dispatch(es) "
+              "after the flip -> CHALLENGER NOT AOT-WARMED")
+        ok = False
+    else:
+        print("verify-fleet: cold_dispatches 0 across the flip -> OK")
+    factor = float(os.environ.get("VERIFY_FLEET_SWAP_FACTOR", "2.0"))
+    during, steady = res["p99_during_swap_ms"], res["steady_p99_ms"]
+    limit = factor * steady
+    if during > limit:
+        print(f"verify-fleet: p99 during swap {during:.1f} ms > "
+              f"{factor:.1f}x steady p99 {steady:.1f} ms -> SWAP "
+              "DISTURBS SERVING")
+        ok = False
+    else:
+        print(f"verify-fleet: p99 during swap {during:.1f} ms vs steady "
+              f"{steady:.1f} ms (limit {limit:.1f} ms) -> OK")
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    base_swap = base.get("serving_p99_during_swap_ms")
+    if base_swap:
+        tol = float(os.environ.get("VERIFY_FLEET_TOL", "0.50"))
+        blimit = base_swap * (1.0 + tol)
+        good = during <= blimit
+        print(f"verify-fleet: p99 during swap {during:.1f} ms vs "
+              f"baseline {base_swap:.1f} ms (limit {blimit:.1f} ms) -> "
+              f"{'OK' if good else 'REGRESSION'}")
+        ok = ok and good
+    else:
+        print("verify-fleet: baseline has no serving_p99_during_swap_ms "
+              "— regression gate skipped (bump BENCH_BASELINE.json to "
+              "arm)")
+    if not res.get("bf16_within_bound"):
+        print(f"verify-fleet: bf16 max error {res['bf16_max_abs_err']:.2e}"
+              f" exceeds its pinned bound {res['bf16_accuracy_bound']:.2e}"
+              " -> PRECISION CONTRACT BROKEN")
+        ok = False
+    else:
+        print(f"verify-fleet: bf16 max error {res['bf16_max_abs_err']:.2e}"
+              f" within pinned bound {res['bf16_accuracy_bound']:.2e} "
+              "-> OK")
+    min_ratio = float(os.environ.get("VERIFY_FLEET_MIN_BF16_RATIO",
+                                     "1.2"))
+    ratio = res["bf16_throughput_ratio"]
+    if ratio < min_ratio:
+        print(f"verify-fleet: bf16 throughput {ratio:.2f}x the f32 "
+              f"serving default (< {min_ratio:.1f}x bar; all-device f32 "
+              f"comparison: {res['bf16_vs_f32_device_ratio']:.2f}x) -> "
+              "NO WIN")
+        ok = False
+    else:
+        print(f"verify-fleet: bf16 throughput {ratio:.2f}x the f32 "
+              f"serving default ({res['bf16_rows_s']:.0f} vs "
+              f"{res['f32_rows_s']:.0f} rows/s; "
+              f"{res['bf16_vs_f32_device_ratio']:.2f}x the all-device "
+              "f32 path) -> OK")
+    return ok
+
+
 def main():
+    if "--fleet" in sys.argv:
+        if not check_fleet():
+            print("verify-fleet: FAILED")
+            return 1
+        print("verify-fleet: all checks passed")
+        return 0
     if "--ooc" in sys.argv:
         if not check_ooc():
             print("verify-ooc: FAILED")
