@@ -57,15 +57,20 @@ verify-serve:
 # observability suite: span tracer nesting/isolation, registry
 # thread-safety, journal atomicity across hard kills, multi-rank merge,
 # /trainz + /metricz (JSON and Prometheus exposition), compile ledger,
-# roofline table, trace export — then the journal-schema lint + trace-
-# export roundtrip on a freshly generated journal (check_journal.py
-# --demo trains a tiny run with telemetry_trace on, validates every
-# record incl. memory/compile/spans, exports the trace and re-loads it
-# through the event-invariant check)
+# roofline table, trace export, comm-latency attribution + fleet
+# aggregator + run-history sentinel (tests/test_comm_obs.py) — then
+# the journal-schema lint + trace-export roundtrip on a freshly
+# generated journal (check_journal.py --demo trains a tiny run with
+# telemetry_trace on, validates every record incl. memory/compile/
+# spans/comm + a run_summary history record, exports the trace and
+# re-loads it through the event-invariant check), and the sentinel
+# self-check (a seeded clean history passes, an injected >20%
+# train-time regression trips)
 verify-obs:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
-	  tests/test_telemetry.py -q
+	  tests/test_telemetry.py tests/test_comm_obs.py -q
 	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_journal.py --demo
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/sentinel.py --self-check
 
 # perf guardrail: the scaled CPU rung (warm compile cache) must stay
 # within 15% of the committed BENCH_BASELINE.json train time at an AUC

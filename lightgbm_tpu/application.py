@@ -142,6 +142,14 @@ class Application:
             init_from_config(cfg)
             Log.info("Parallel training over a %d-device mesh "
                      "(tree_learner=%s)", cfg.num_machines, cfg.tree_learner)
+            if cfg.telemetry_port > 0:
+                # rank-offset the /trainz port so every rank of a
+                # single-host gang binds (same-port ranks would
+                # silently lose all but one endpoint) and the fleet
+                # aggregator's targets are derivable: rank r serves on
+                # telemetry_port + r (docs/Observability.md)
+                import jax
+                cfg.telemetry_port += jax.process_index()
         self.boosting = create_boosting(cfg.boosting_type, cfg.input_model)
         self.objective = create_objective(cfg.objective, cfg)
         self._load_data()
@@ -416,6 +424,14 @@ class Application:
                 with heartbeat.collective_guard("journal_merge_barrier"):
                     multihost_utils.process_allgather(
                         np.asarray([b.iter], dtype=np.int64))
+        if cfg.run_history and jax.process_index() == 0:
+            # one compact run_summary per training run: the trend line
+            # tools/sentinel.py judges (telemetry/history.py)
+            from .telemetry import history
+            history.append_run_summary(
+                cfg.run_history, "train",
+                **history.booster_summary(
+                    b, train_s=round(time.time() - start, 3)))
         # final `done` beat + monitor stop: a cleanly finished rank must
         # never be declared dead by peers still tearing down
         heartbeat.shutdown(done=True)

@@ -289,6 +289,21 @@ class Config:
     # log line (the `python -m lightgbm_tpu.serve --slow-request-ms`
     # flag mirrors it); 0 = off
     slow_request_ms: float = 1000.0
+    # collective latency/overlap attribution (telemetry/comm_profile.py):
+    # one `comm` journal record per iteration/block with per-collective
+    # host-visible waits, comm_overlap_pct and the straggler view on
+    # /trainz. On by default — it only measures when `telemetry` is on
+    # (the timing sink is what arms the guarded sections)
+    comm_telemetry: bool = True
+    # append one `run_summary` record to this JSONL file at run_end
+    # (telemetry/history.py; `tools/sentinel.py` trends over the last K
+    # records and verify-perf gates on it); "" = off
+    run_history: str = ""
+    # documented default port for the fleet aggregator CLI
+    # (`python -m lightgbm_tpu.telemetry.aggregate --port`); multi-rank
+    # CLI runs offset `telemetry_port` by rank so every rank of a
+    # single-host gang is scrapable (application.py)
+    aggregate_port: int = 0
 
     # --- model-quality observability (telemetry/quality.py,
     # io/profile.py, serving/drift.py; no reference equivalent beyond
@@ -555,6 +570,7 @@ class Config:
               "collective_timeout_s should be >= 0")
         check(self.max_restarts >= 0, "max_restarts should be >= 0")
         check(self.telemetry_port >= 0, "telemetry_port should be >= 0")
+        check(self.aggregate_port >= 0, "aggregate_port should be >= 0")
         check(0.0 <= self.roofline_warn_fraction <= 1.0,
               "roofline_warn_fraction in [0, 1]")
         check(self.slow_request_ms >= 0,

@@ -358,6 +358,11 @@ class GBDT:
         self.quality = None
         self.dataset_profile = None
         self._last_metric_values = {}
+        # collective latency/overlap attribution (`comm_telemetry`
+        # knob; telemetry/comm_profile.py): fed by the heartbeat
+        # timing sink, flushed into one `comm` journal record per
+        # iteration/block
+        self.comm_profile = None
 
     # ------------------------------------------------------------------ init
     def init(self, config, train_data, objective, training_metrics=()):
@@ -469,16 +474,43 @@ class GBDT:
         import weakref
         ref = weakref.ref(self)  # process-global sinks and the /trainz
         #                          thread must not pin a dropped booster
+        if (self.comm_profile is None
+                and getattr(config, "comm_telemetry", True)):
+            from ..telemetry.comm_profile import CommProfiler
+            self.comm_profile = CommProfiler(rank=faults.current_rank())
 
         def timing_sink(name, seconds):
             gbdt = ref()
-            if gbdt is not None:
-                gbdt.metrics.observe("sync_wait_s", seconds)
+            if gbdt is None:
+                # the booster died without close_telemetry (Python-API
+                # drop): self-unbind so guarded sections elsewhere in
+                # the process go back to the zero-overhead path — if
+                # this sink is still being called, it IS the bound one
+                heartbeat.bind_timing_sink(None)
+                return
+            gbdt.metrics.observe("sync_wait_s", seconds)
+            if gbdt.comm_profile is not None:
+                gbdt.comm_profile.record(name, seconds)
 
-        # collective sync-wait seconds land in the registry whenever the
-        # watchdog is armed (parallel/heartbeat.py; the armed section is
-        # the measurement, so an unarmed watchdog stays zero-overhead)
+        # collective sync-wait seconds land in the registry + the comm
+        # profiler: binding the sink is what makes every guarded
+        # section measure, armed watchdog or not
+        # (parallel/heartbeat.py)
         heartbeat.bind_timing_sink(timing_sink)
+        self._timing_sink_fn = timing_sink
+        if self.comm_profile is not None:
+            prof = self.comm_profile
+            # publish this rank's cumulative collective wait in the
+            # heartbeat beats so peers/aggregators compute straggler
+            # deltas (comm_profile.straggler_deltas); holds the
+            # profiler, not the booster — cleared by close_telemetry
+            # and heartbeat.shutdown
+
+            def beat_extra():
+                return {"comm_wait_s": round(prof.cum_wait_s, 6)}
+
+            heartbeat.bind_beat_extra(beat_extra)
+            self._beat_extra_fn = beat_extra
         if self.journal is None:
             directory = (getattr(config, "telemetry_dir", "")
                          or getattr(config, "snapshot_dir", ""))
@@ -508,6 +540,12 @@ class GBDT:
                     return None
                 return gbdt.quality.snapshot()
 
+            def comm_fn():
+                gbdt = ref()
+                if gbdt is None or gbdt.comm_profile is None:
+                    return None
+                return gbdt.comm_profile.snapshot()
+
             self._trainz_server = trainz.start_trainz(
                 trainz.build_sources(
                     iteration_fn=iteration_fn,
@@ -516,7 +554,9 @@ class GBDT:
                     journal=self.journal,
                     roofline_warn_fraction=self._roofline_warn_fraction,
                     quality_fn=(quality_fn if self.quality is not None
-                                else None)),
+                                else None),
+                    comm_fn=(comm_fn if self.comm_profile is not None
+                             else None)),
                 port=port)
 
     def _journal_iteration(self, **fields):
@@ -529,7 +569,23 @@ class GBDT:
         self.journal.iteration(self.iter,
                                phases=self.tracer.delta_snapshot(),
                                **fields)
+        self._journal_comm()
         self._journal_introspection()
+
+    def _journal_comm(self):
+        """One `comm` record per iteration/block (`comm_telemetry`
+        knob): per-collective host-visible waits since the last record,
+        the derived comm_overlap_pct, and registry gauges so /trainz +
+        Prometheus carry the live values (telemetry/comm_profile.py)."""
+        if self.comm_profile is None:
+            return
+        rec = self.comm_profile.flush(self.iter)
+        if rec is None:
+            return
+        self.metrics.set("comm_overlap_pct", rec["overlap_pct"])
+        self.metrics.set("comm_wait_s", rec["wait_s"])
+        if self.journal is not None:
+            self.journal.event("comm", **rec)
 
     def _journal_introspection(self):
         """Memory watermarks + newly-recorded jit lowerings, appended at
@@ -618,6 +674,18 @@ class GBDT:
             from ..telemetry import trainz
             trainz.stop_trainz(self._trainz_server)
             self._trainz_server = None
+        # drop OUR process-global hooks (a newer booster's stay): an
+        # unbound sink returns guarded sections to zero-overhead, and
+        # beats must stop publishing a closed booster's frozen
+        # comm_wait_s (wrong straggler attribution for peers)
+        if (getattr(self, "_timing_sink_fn", None) is not None
+                and heartbeat._TIMING_SINK is self._timing_sink_fn):
+            heartbeat.bind_timing_sink(None)
+        self._timing_sink_fn = None
+        if (getattr(self, "_beat_extra_fn", None) is not None
+                and heartbeat._BEAT_EXTRA is self._beat_extra_fn):
+            heartbeat.bind_beat_extra(None)
+        self._beat_extra_fn = None
 
     def _warn_roofline(self):
         """End-of-run roofline check (`roofline_warn_fraction` knob):

@@ -142,18 +142,25 @@ class CollectiveWatchdog:
 
     @contextlib.contextmanager
     def armed(self, name):
-        if self.timeout_s <= 0:
+        # a bound timing sink turns every guarded section into a
+        # measurement even when the watchdog itself is disarmed
+        # (timeout 0): comm telemetry must not require arming an abort
+        # timer. With neither, the guard stays zero-overhead.
+        if self.timeout_s <= 0 and _TIMING_SINK is None:
             yield
             return
-        timer = threading.Timer(self.timeout_s, self._expire,
-                                (name, self.iteration))
-        timer.daemon = True
+        timer = None
+        if self.timeout_s > 0:
+            timer = threading.Timer(self.timeout_s, self._expire,
+                                    (name, self.iteration))
+            timer.daemon = True
+            timer.start()
         start = time.monotonic()
-        timer.start()
         try:
             yield
         finally:
-            timer.cancel()
+            if timer is not None:
+                timer.cancel()
             elapsed = time.monotonic() - start
             self.timings[name] = elapsed
             self.last_sync_s = elapsed
@@ -220,6 +227,16 @@ class HeartbeatService:
             beat["done"] = True
         if self.last_snapshot is not None:
             beat["snapshot_iteration"] = int(self.last_snapshot[0])
+        if _BEAT_EXTRA is not None:
+            # telemetry piggyback (telemetry/comm_profile.py publishes
+            # this rank's cumulative collective wait so peers can
+            # compute straggler deltas without a new channel)
+            try:
+                extra = _BEAT_EXTRA() or {}
+                beat.update({k: v for k, v in extra.items()
+                             if k not in beat})
+            except Exception:   # telemetry must never kill the beat
+                pass
         atomic_write_json(heartbeat_path(self.directory, self.rank), beat)
 
     def notify_snapshot(self, iteration, path):
@@ -346,15 +363,26 @@ class HeartbeatService:
 WATCHDOG = CollectiveWatchdog(0.0)
 _SERVICE = None
 _TIMING_SINK = None   # (collective_name, elapsed_s) -> None; telemetry
+_BEAT_EXTRA = None    # () -> dict merged into each published beat
 
 
 def bind_timing_sink(fn):
-    """Route every armed section's elapsed time into a telemetry sink
-    (the booster's metrics registry observes `sync_wait_s`); None
-    unbinds. Only armed sections measure, so an unarmed watchdog stays
+    """Route every guarded section's elapsed time into a telemetry sink
+    (the booster's metrics registry observes `sync_wait_s`, the comm
+    profiler attributes per-collective waits); None unbinds. A bound
+    sink makes guarded sections measure even with the watchdog timer
+    disarmed; with neither sink nor timeout the guard is
     zero-overhead."""
     global _TIMING_SINK
     _TIMING_SINK = fn
+
+
+def bind_beat_extra(fn):
+    """Merge `fn()`'s dict into every published heartbeat (telemetry
+    piggyback — e.g. this rank's cumulative collective wait seconds so
+    peers/aggregators can compute straggler deltas); None unbinds."""
+    global _BEAT_EXTRA
+    _BEAT_EXTRA = fn
 
 
 def _journal_abort(exit_code, reason, **fields):
@@ -428,3 +456,4 @@ def shutdown(done=True):
         _SERVICE = None
     WATCHDOG.timeout_s = 0.0
     bind_timing_sink(None)   # drop the telemetry sink's booster ref
+    bind_beat_extra(None)

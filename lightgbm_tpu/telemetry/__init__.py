@@ -20,9 +20,19 @@ The training-side observability stack (docs/Observability.md):
 - `roofline.TABLE` — live per-kernel achieved bytes/s vs a measured
   STREAM-style peak.
 - `prometheus.render` — the registry in Prometheus text exposition
-  (`?format=prometheus` on /metricz and /trainz).
+  (`?format=prometheus` on /metricz and /trainz), with the canonical
+  naming contract (`canonical_name`/`lint_names`) and the labeled
+  multi-source page (`render_multi`).
 - `export.export_trace` — the journal (+ span-ring dump) as Chrome
-  trace-event JSON for Perfetto (`tools/export_trace.py`).
+  trace-event JSON for Perfetto (`tools/export_trace.py`), with
+  cross-rank collective flow events.
+- `comm_profile.CommProfiler` — per-collective latency attribution,
+  `comm_overlap_pct` and straggler deltas (`comm_telemetry` knob).
+- `aggregate.FleetAggregator` — one poller merging every rank's
+  /trainz + every replica's /metricz
+  (`python -m lightgbm_tpu.telemetry.aggregate`).
+- `history.append_run_summary` — the append-only RUN_HISTORY.jsonl
+  store `tools/sentinel.py` trends over.
 
 Everything here is jax-free unless the jax-annotation passthrough is
 explicitly enabled (the compile ledger's `install()` touches jax's
@@ -30,9 +40,13 @@ monitoring API only when jax is importable), so the supervisor and CPU
 test harness can import it without touching the accelerator runtime.
 """
 
-from . import export, journal, ledger, prometheus  # noqa: F401
+from . import aggregate, comm_profile, export, history  # noqa: F401
+from . import journal, ledger, prometheus  # noqa: F401
 from . import registry, roofline, trace, trainz  # noqa: F401
+from .aggregate import FleetAggregator  # noqa: F401
+from .comm_profile import CommProfiler  # noqa: F401
 from .export import build_trace, export_trace, validate_trace  # noqa: F401
+from .history import append_run_summary, read_history  # noqa: F401
 from .journal import RunJournal, merge_journals, read_journal  # noqa: F401
 from .ledger import LEDGER, CompileLedger, sample_memory  # noqa: F401
 from .registry import MetricsRegistry  # noqa: F401

@@ -20,7 +20,14 @@ a multi-rank crash → restart → resume run reads as one timeline:
   counter tracks (Perfetto plots them);
 - a journal `spans` record (the recent-span ring dumped at close)
   becomes fine-grained slices on per-thread lanes — concurrent
-  batcher/heartbeat threads get their own tracks via the span tid.
+  batcher/heartbeat threads get their own tracks via the span tid;
+- **`comm` records** (collective latency attribution,
+  telemetry/comm_profile.py) get a dedicated `comm` lane per rank:
+  one slice per collective wait, a `comm_overlap` counter track, and
+  cross-rank **flow events** (the Chrome `s`/`t`/`f` arrows)
+  connecting the SAME iteration's matching collective slice on every
+  rank — a hung or skewed exchange is a visibly broken/stretched
+  arrow between rank tracks.
 
 Everything maps through wall-clock epoch seconds (journal `ts`; span
 offsets + the dump's `epoch_ts`), rebased to the run's first event so
@@ -37,6 +44,7 @@ from . import journal as journal_mod
 # fixed thread lanes inside each rank's process track
 TID_TRAIN = 0
 TID_SUPERVISOR = 1
+TID_COMM = 2         # collective wait slices (`comm` records)
 TID_SPAN_BASE = 16   # span recording threads map to 16, 17, ...
 
 _INSTANT_EVENTS = {"run_start", "run_end", "resume", "truncate",
@@ -157,6 +165,9 @@ def build_trace(records):
             if starts:
                 t0 = min(t0, _num(rec.get("epoch_ts"), t0) + min(starts))
     b = _TraceBuilder(t0)
+    # (iteration, collective) -> [(rank, anchor_ts_us)] for the
+    # cross-rank flow pass below
+    comm_anchors = {}
 
     for rec in records:
         event = rec.get("event")
@@ -196,6 +207,28 @@ def build_trace(records):
                         if isinstance(v, (int, float))}
                 if vals:
                     b.counter(rank, "collective_bytes", ts, vals)
+        elif event == "comm":
+            # one slice per collective wait on the rank's comm lane,
+            # laid end to end backwards from the record's ts (the
+            # per-phase convention); each slice's midpoint is the flow
+            # anchor — the arrow binds to the enclosing slice
+            b._ensure_thread(rank, TID_COMM, "comm")
+            waits = {k: _num(v) for k, v in (rec.get("waits")
+                                             or {}).items()}
+            it = rec.get("iteration", 0)
+            cursor = ts - sum(waits.values())
+            for cname, csecs in sorted(waits.items()):
+                if csecs <= 0:
+                    continue
+                b.slice(rank, TID_COMM, cname, cursor, csecs,
+                        {"iteration": it})
+                anchor = b._us(cursor + csecs / 2.0)
+                comm_anchors.setdefault((it, cname), []).append(
+                    (rank, anchor))
+                cursor += csecs
+            b.counter(rank, "comm_overlap", ts,
+                      {k: rec[k] for k in ("overlap_pct", "wait_s",
+                                           "dispatch_s") if k in rec})
         elif event == "metrics":
             b.counter(rank, "metrics", ts, rec.get("values") or {})
         elif event == "quality":
@@ -258,6 +291,28 @@ def build_trace(records):
         # unknown events are skipped: the exporter must keep working on
         # journals from a newer schema
 
+    # cross-rank flow events: one arrow chain per (iteration,
+    # collective) that >= 2 ranks recorded — start (`s`) on the
+    # lowest rank's slice, steps (`t`) through the middle, finish
+    # (`f`) on the last; matching name+cat+id is what the Chrome/
+    # Perfetto loaders chain on, and each event's ts lies inside its
+    # rank's slice so the arrow binds to it
+    flow_id = 0
+    for (it, cname), anchors in sorted(comm_anchors.items()):
+        ranks = sorted(set(anchors))
+        if len({r for r, _ in ranks}) < 2:
+            continue
+        flow_id += 1
+        last = len(ranks) - 1
+        for idx, (rank, ts_us) in enumerate(ranks):
+            ph = "s" if idx == 0 else ("f" if idx == last else "t")
+            ev = {"name": f"{cname} it{it}", "ph": ph,
+                  "cat": "comm_flow", "id": flow_id, "pid": rank,
+                  "tid": TID_COMM, "ts": ts_us}
+            if ph == "f":
+                ev["bp"] = "e"   # bind to the enclosing slice
+            b.events.append(ev)
+
     # stable nesting: same-timestamp slices sort longest-first so
     # children fall inside their parent when Perfetto infers stacks
     b.events.sort(key=lambda e: (e.get("pid", 0), e.get("tid", 0),
@@ -281,7 +336,7 @@ def validate_trace(trace):
             continue
         if not isinstance(e.get("name"), str) or not e.get("name"):
             errors.append(f"event {i}: missing name")
-        if e.get("ph") not in ("X", "i", "C", "M"):
+        if e.get("ph") not in ("X", "i", "C", "M", "s", "t", "f"):
             errors.append(f"event {i}: unknown phase {e.get('ph')!r}")
         if e.get("ph") != "M":
             ts = e.get("ts")
@@ -294,6 +349,12 @@ def validate_trace(trace):
             dur = e.get("dur")
             if not isinstance(dur, (int, float)) or dur <= 0:
                 errors.append(f"event {i}: X event needs dur > 0")
+        if e.get("ph") in ("s", "t", "f"):
+            # flow events must carry a binding id, and a flow id used
+            # by only one event draws nothing — every id needs a
+            # start AND a finish
+            if not isinstance(e.get("id"), (int, str)):
+                errors.append(f"event {i}: flow event needs an id")
         if e.get("ph") == "C":
             # counter tracks (training_health, metrics, memory_bytes,
             # quality) must carry a non-empty all-numeric args dict —
@@ -304,6 +365,14 @@ def validate_trace(trace):
             elif any(not isinstance(v, (int, float))
                      or isinstance(v, bool) for v in args.values()):
                 errors.append(f"event {i}: C event args must be numeric")
+    flows = {}
+    for e in events:
+        if isinstance(e, dict) and e.get("ph") in ("s", "t", "f"):
+            flows.setdefault(e.get("id"), []).append(e.get("ph"))
+    for fid, phases in flows.items():
+        if phases.count("s") != 1 or phases.count("f") != 1:
+            errors.append(f"flow id {fid!r}: needs exactly one 's' and "
+                          f"one 'f', got {sorted(phases)}")
     try:
         json.dumps(trace, allow_nan=False)
     except (TypeError, ValueError) as exc:

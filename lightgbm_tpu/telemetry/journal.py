@@ -49,6 +49,13 @@ MERGED_NAME = "journal.jsonl"
 
 COMMON_FIELDS = {"ts": float, "event": str, "rank": int}
 
+# present on every record written by this version, but OPTIONAL in the
+# schema so journals from older runs stay valid: `mono` is the writing
+# process's time.monotonic() — within one rank it orders records even
+# when the wall clock steps (NTP slew, a skewed host), which is what
+# `merge_journals` sorts each rank file by before interleaving ranks
+OPTIONAL_COMMON_FIELDS = {"mono": float, "source": str}
+
 SCHEMA = {
     "run_start": {"required": {"schema": int, "pid": int},
                   "optional": {"run_id": str, "argv": list,
@@ -105,6 +112,34 @@ SCHEMA = {
                           "learner": str, "source": str}},
     "run_end": {"required": {"iterations": int},
                 "optional": {"train_s": float, "source": str}},
+    # per-iteration/block collective latency attribution (`comm_telemetry`
+    # knob; telemetry/comm_profile.py): host-visible seconds blocked in
+    # each armed collective section since the last record (`waits`),
+    # split into pure sync waits (`wait_s` — leaf_count_sync,
+    # row_leaf_gather, ...) vs dispatch windows that contain compute
+    # (`dispatch_s` — tree_build, fused_block), plus the wall seconds
+    # the record covers and the derived comm_overlap_pct
+    "comm": {"required": {"iteration": int},
+             "optional": {"waits": dict, "wait_s": float,
+                          "dispatch_s": float, "wall_s": float,
+                          "overlap_pct": float, "source": str}},
+    # one compact per-run summary appended to RUN_HISTORY.jsonl
+    # (telemetry/history.py; tools/sentinel.py trends over the last K
+    # of these) — NOT part of the per-run journal timeline, but the
+    # same schema machinery lints it
+    "run_summary": {"required": {"kind": str},
+                    "optional": {"run_id": str, "label": str,
+                                 "platform": str, "rows": int,
+                                 "iterations": int, "train_s": float,
+                                 "auc": float, "metrics": dict,
+                                 "peak_memory_bytes": int,
+                                 "collective_bytes": int,
+                                 "collective_bytes_per_tree": float,
+                                 "comm_overlap_pct": float,
+                                 "prefetch_overlap_pct": float,
+                                 "serving_p99_ms": float,
+                                 "telemetry_overhead_pct": float,
+                                 "source": str}},
     # fleet registry transitions (fleet/registry.py): one record per
     # pointer move / quarantine, with the validation metrics that drove
     # the decision — the Perfetto export renders them as instant
@@ -168,6 +203,11 @@ def validate_record(rec):
             errors.append(f"missing common field {name!r}")
         elif not _type_ok(rec[name], typ):
             errors.append(f"field {name!r} has type "
+                          f"{type(rec[name]).__name__}, want {typ.__name__}")
+    for name, typ in OPTIONAL_COMMON_FIELDS.items():
+        if name in rec and rec[name] is not None \
+                and not _type_ok(rec[name], typ):
+            errors.append(f"common field {name!r} has type "
                           f"{type(rec[name]).__name__}, want {typ.__name__}")
     event = rec.get("event")
     if not isinstance(event, str):
@@ -253,7 +293,8 @@ class RunJournal:
         line. Never raises — a full disk must not kill training."""
         if self._fd is None:
             return
-        rec = {"ts": time.time(), "event": event, "rank": self.rank}
+        rec = {"ts": time.time(), "mono": round(time.monotonic(), 6),
+               "event": event, "rank": self.rank}
         if self.source is not None:
             rec["source"] = self.source
         rec.update(fields)
@@ -328,23 +369,99 @@ def tail(path, n=20):
     return records[-int(n):]
 
 
-def merge_journals(directory, out_path=None):
-    """Merge every rank's journal into one wall-time-sorted timeline
-    (rank 0 calls this at end of training; `tools/check_journal.py`
-    lints the result). The sort is stable, so same-timestamp records
-    keep rank-file order. Returns the merged path or None when there
-    was nothing to merge."""
+def detect_clock_skew(per_rank_records):
+    """Cross-rank wall-clock skew estimate from a merged run's records:
+    the same completed iteration N is a near-synchronization point
+    across ranks (a data-parallel iteration cannot finish on one rank
+    while peers are still many seconds inside it — the collectives
+    serialize them), so the spread of `iteration`-record wall
+    timestamps at the same iteration index, minimized over iterations,
+    bounds the wall-clock disagreement. Straggling inflates individual
+    spreads, which is why the MINIMUM over iterations is the estimate.
+    Returns (skew_s, iteration) or (0.0, None) with fewer than two
+    ranks' worth of matching records."""
+    by_iter = {}
+    for rank, records in per_rank_records.items():
+        for rec in records:
+            if rec.get("event") != "iteration":
+                continue
+            it = rec.get("iteration")
+            ts = rec.get("ts")
+            if isinstance(it, int) and isinstance(ts, (int, float)):
+                # last record per (rank, iteration): restarts replay
+                by_iter.setdefault(it, {})[rank] = float(ts)
+    best = None
+    for it, ranks in by_iter.items():
+        if len(ranks) < 2:
+            continue
+        spread = max(ranks.values()) - min(ranks.values())
+        if best is None or spread < best[0]:
+            best = (spread, it)
+    return best if best is not None else (0.0, None)
+
+
+def merge_journals(directory, out_path=None, skew_threshold_s=2.0):
+    """Merge every rank's journal into one timeline (rank 0 calls this
+    at end of training; `tools/check_journal.py` lints the result).
+
+    Each rank file is first ordered by its own `mono` timestamps (wall
+    clocks can step mid-run; monotonic time cannot), then ranks are
+    interleaved by wall time — the only cross-host ordering available.
+    When the cross-rank wall-clock skew estimate (`detect_clock_skew`)
+    exceeds `skew_threshold_s`, the merge does not silently interleave
+    a lie: it logs a warning and appends a `note` record naming the
+    measured skew so readers of the merged timeline know cross-rank
+    order is unreliable at that scale. Returns the merged path or None
+    when there was nothing to merge."""
     files = rank_files(directory)
     if not files:
         return None
-    merged = []
+    per_rank = {}
     for path in files:
         records, bad = read_journal(path)
         if bad:
             Log.warning("journal merge: skipped %d torn line(s) in %s",
                         bad, path)
-        merged.extend(records)
-    merged.sort(key=lambda r: r.get("ts", 0.0))
+        # within-rank order IS file order: O_APPEND writes land in real
+        # time order even when the supervisor and child co-write one
+        # rank file, and a stepped wall clock cannot reorder them. Do
+        # NOT sort by `mono` here — CLOCK_MONOTONIC resets on reboot,
+        # so a crash -> reboot -> resume run's resumed records would
+        # sort before its pre-crash ones. `mono` exists for readers
+        # comparing two records of one incarnation.
+        per_rank[path] = records
+    # k-way interleave by wall time that NEVER reorders within a rank:
+    # wall clocks only decide which rank's next record comes first —
+    # each rank's own append-ordered stream is consumed in order even
+    # when its wall clock stepped backwards mid-run
+    import heapq
+    streams = [recs for recs in per_rank.values() if recs]
+    heap = [(recs[0].get("ts", 0.0), i, 0)
+            for i, recs in enumerate(streams)]
+    heapq.heapify(heap)
+    merged = []
+    while heap:
+        _, i, pos = heapq.heappop(heap)
+        merged.append(streams[i][pos])
+        if pos + 1 < len(streams[i]):
+            heapq.heappush(heap, (streams[i][pos + 1].get("ts", 0.0),
+                                  i, pos + 1))
+    skew_s, skew_iter = detect_clock_skew(per_rank)
+    if skew_s > skew_threshold_s:
+        Log.warning(
+            "journal merge: cross-rank wall-clock skew ~%.2fs "
+            "(iteration %s timestamps disagree by that much; threshold "
+            "%.1fs) — cross-rank ordering in the merged timeline is "
+            "unreliable, trust within-rank order only", skew_s,
+            skew_iter, skew_threshold_s)
+        merged.append({"ts": time.time(),
+                       "mono": round(time.monotonic(), 6),
+                       "event": "note", "rank": 0,
+                       "msg": (f"clock_skew: cross-rank wall-clock skew "
+                               f"~{skew_s:.2f}s measured at iteration "
+                               f"{skew_iter} (threshold "
+                               f"{skew_threshold_s:.1f}s); merged "
+                               "cross-rank order is unreliable")})
     out_path = out_path or os.path.join(os.fspath(directory), MERGED_NAME)
     tmp = f"{out_path}.tmp.{os.getpid()}"
     try:

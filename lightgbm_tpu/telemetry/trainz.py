@@ -16,6 +16,10 @@ training run as one JSON document:
 - `compile`: the jit-lowering ledger (counts, seconds, cache hits)
 - `roofline`: live per-kernel achieved bandwidth vs the measured
   STREAM peak (telemetry/roofline.py)
+- `comm`: per-collective wait attribution, comm_overlap_pct and the
+  per-rank straggler deltas (telemetry/comm_profile.py; the fleet
+  aggregator `python -m lightgbm_tpu.telemetry.aggregate` merges this
+  source across every rank)
 
 Also serves /healthz (liveness) and /metricz (the registry alone —
 the training-side scrape target mirroring the serving layer's).
@@ -142,7 +146,7 @@ class TrainzHandler(BaseHTTPRequestHandler):
 
 def build_sources(iteration_fn=None, tracer=None, registry=None,
                   journal=None, tail_n=20, roofline_warn_fraction=0.0,
-                  quality_fn=None):
+                  quality_fn=None, comm_fn=None):
     """Assemble the /trainz source map from whatever exists. The
     heartbeat service is resolved lazily per request (it may start
     after the endpoint does); memory/compile/roofline read the
@@ -159,6 +163,11 @@ def build_sources(iteration_fn=None, tracer=None, registry=None,
         # split-ledger totals + top features by gain
         # (telemetry/quality.py QualityTracker.snapshot)
         sources["quality"] = quality_fn
+    if comm_fn is not None:
+        # collective latency attribution: per-collective waits,
+        # comm_overlap_pct, per-rank straggler deltas
+        # (telemetry/comm_profile.py CommProfiler.snapshot)
+        sources["comm"] = comm_fn
 
     def heartbeats():
         from ..parallel import heartbeat

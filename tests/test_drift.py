@@ -424,8 +424,12 @@ def test_drift_e2e_shifted_feature_trips_everything(served_model,
     prom = urllib.request.urlopen(url + "/metricz?format=prometheus",
                                   timeout=30).read().decode()
     assert "lightgbm_tpu_drift_psi_max" in prom
-    assert f"lightgbm_tpu_drift_psi_{name0}" in prom
+    # canonical exposition names are lowercase (telemetry/prometheus.py
+    # naming audit) — feature-derived gauges fold case
+    assert f"lightgbm_tpu_drift_psi_{name0.lower()}" in prom
     assert "lightgbm_tpu_skew_count 0" in prom
+    from lightgbm_tpu.telemetry import prometheus as prom_mod
+    assert prom_mod.lint_names(prom) == []
 
     # the structured warning log named the drifting feature
     out = capsys.readouterr().out

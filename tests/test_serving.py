@@ -715,7 +715,10 @@ def test_metricz_prometheus_under_live_traffic(binary_model):
                     timeout=30) as r:
                 assert r.headers["Content-Type"].startswith("text/plain")
                 page = prometheus.parse(r.read().decode())
-            assert "lightgbm_tpu_request_count" in page
+            # canonical exposition names: counters end _total, `_ms`
+            # metrics render in base-unit seconds (the naming audit,
+            # telemetry/prometheus.py)
+            assert "lightgbm_tpu_request_total" in page
             assert "lightgbm_tpu_queue_depth" in page
             parsed_pages += 1
         stop.set()
@@ -723,17 +726,19 @@ def test_metricz_prometheus_under_live_traffic(binary_model):
             w.join(timeout=30)
         assert not errors, errors
         assert parsed_pages >= 20
-        final = prometheus.parse(urllib.request.urlopen(
+        final_text = urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metricz?format=prometheus",
-            timeout=30).read().decode())
-        assert final["lightgbm_tpu_request_count"] > 0
-        assert final["lightgbm_tpu_rows_served"] > 0
-        assert 'lightgbm_tpu_latency_ms{quantile="0.5"}' in final
+            timeout=30).read().decode()
+        assert prometheus.lint_names(final_text) == []
+        final = prometheus.parse(final_text)
+        assert final["lightgbm_tpu_request_total"] > 0
+        assert final["lightgbm_tpu_rows_served_total"] > 0
+        assert 'lightgbm_tpu_latency_seconds{quantile="0.5"}' in final
         # JSON view still intact next to the exposition view
         snap = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metricz", timeout=30).read())
         assert snap["request_count"] == int(
-            final["lightgbm_tpu_request_count"])
+            final["lightgbm_tpu_request_total"])
     finally:
         stop.set()
         srv.shutdown()

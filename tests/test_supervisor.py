@@ -128,10 +128,25 @@ def test_watchdog_fires_with_rank_iteration_collective(tmp_path):
 
 
 def test_watchdog_disabled_is_free():
+    # zero-overhead contract: disarmed AND no telemetry timing sink
+    # bound -> no timer, no timings bookkeeping. (A bound sink makes
+    # guarded sections measure even when disarmed — comm telemetry,
+    # telemetry/comm_profile.py — so pin the unbound state first: a
+    # leaked sink from an earlier telemetry run would break the free
+    # path this test guards.)
+    hb.bind_timing_sink(None)
     wd = hb.CollectiveWatchdog(0.0)
     with wd.armed("anything"):
-        pass  # no timer, no timings bookkeeping
+        pass
     assert wd.timings == {}
+    # and the flip side: binding a sink is what turns measurement on
+    hb.bind_timing_sink(lambda name, s: None)
+    try:
+        with wd.armed("measured"):
+            pass
+    finally:
+        hb.bind_timing_sink(None)
+    assert "measured" in wd.timings
 
 
 # ---------------------------------------------------------- rank faults

@@ -602,18 +602,24 @@ def test_prometheus_render_parse_roundtrip():
                                            "iteration": 9,
                                            "not a number": "skipped"})
     parsed = prometheus.parse(text)   # raises on malformed exposition
-    assert parsed["lightgbm_tpu_tree_build_dispatches"] == 7
+    # the naming audit's canonical exposition names: counters end
+    # _total, `_ms` metrics scale to base-unit `_seconds`
+    assert parsed["lightgbm_tpu_tree_build_dispatches_total"] == 7
     assert parsed["lightgbm_tpu_device_bytes_in_use"] == 12345
-    assert parsed['lightgbm_tpu_latency_ms{quantile="0.5"}'] in (50.0, 51.0)
-    assert parsed["lightgbm_tpu_latency_ms_count"] == 100
-    assert parsed["lightgbm_tpu_latency_ms_sum"] == pytest.approx(5050.0)
+    assert parsed['lightgbm_tpu_latency_seconds{quantile="0.5"}'] \
+        in (0.050, 0.051)
+    assert parsed["lightgbm_tpu_latency_seconds_count"] == 100
+    assert parsed["lightgbm_tpu_latency_seconds_sum"] == pytest.approx(
+        5.050)
     # illegal chars sanitize instead of corrupting the page; the
     # non-numeric extra is skipped entirely
     assert parsed["lightgbm_tpu_roofline_hist_bytes"] == 3.5
     assert parsed["lightgbm_tpu_iteration"] == 9
     assert not any("not" in k for k in parsed)
-    assert "# TYPE lightgbm_tpu_tree_build_dispatches counter" in text
-    assert "# TYPE lightgbm_tpu_latency_ms summary" in text
+    assert "# TYPE lightgbm_tpu_tree_build_dispatches_total counter" \
+        in text
+    assert "# TYPE lightgbm_tpu_latency_seconds summary" in text
+    assert prometheus.lint_names(text) == []
 
 
 def test_prometheus_parse_rejects_malformed():
@@ -664,9 +670,11 @@ def test_trainz_metricz_and_prometheus_endpoints(tmp_path):
             ctype, raw = get(path)
             assert ctype.startswith("text/plain")
             parsed = prometheus.parse(raw.decode())
-            assert parsed["lightgbm_tpu_tree_build_dispatches"] == 4
+            assert parsed["lightgbm_tpu_tree_build_dispatches_total"] \
+                == 4
             assert parsed["lightgbm_tpu_iteration"] == 3
             assert parsed["lightgbm_tpu_host_rss_bytes"] > 0
+            assert prometheus.lint_names(raw.decode()) == []
     finally:
         stop_trainz(srv)
         j.close()

@@ -73,10 +73,12 @@ def expand(paths):
 
 
 def run_demo():
-    """Train 3 iterations with telemetry (the span-ring dump AND
-    quality telemetry) on, lint the journal — proving the writer honors
-    the schema end to end, including the memory/compile/spans/quality
-    records — then round-trip it through the trace exporter:
+    """Train 3 iterations with telemetry (the span-ring dump, quality
+    telemetry AND comm telemetry) on, lint the journal — proving the
+    writer honors the schema end to end, including the
+    memory/compile/spans/quality/comm records — write + lint a
+    `run_summary` history record (telemetry/history.py), then
+    round-trip the journal through the trace exporter:
     export -> json.load -> event invariants (the `make verify-obs`
     acceptance path)."""
     import json as json_mod
@@ -86,7 +88,7 @@ def run_demo():
     import numpy as np
 
     import lightgbm_tpu as lgb
-    from lightgbm_tpu.telemetry import export
+    from lightgbm_tpu.telemetry import export, history
 
     d = tempfile.mkdtemp(prefix="journal_demo_")
     try:
@@ -99,20 +101,28 @@ def run_demo():
                              "telemetry_trace": True,
                              "quality_telemetry": True},
                             lgb.Dataset(x, y), num_boost_round=3)
+        # one run_summary into a demo history file, linted with the
+        # same schema machinery as the journal
+        hist_path = history.append_run_summary(
+            os.path.join(d, "RUN_HISTORY.jsonl"), "demo",
+            **history.booster_summary(booster.gbdt, train_s=0.1))
         # end the run the way a finishing process does: the close drains
         # the final introspection records + the span-ring dump
         booster.gbdt.close_telemetry()
-        rc = main([d])
+        rc = main([d] + ([hist_path] if hist_path else []))
         print("demo journal lint:", "OK" if rc == 0 else "FAILED")
         if rc != 0:
             return rc
         events = {rec.get("event")
                   for rec in export.collect_records(d)[0]}
-        for required in ("memory", "spans", "quality"):
+        for required in ("memory", "spans", "quality", "comm"):
             if required not in events:
                 print(f"demo journal: no `{required}` record — the "
                       "introspection drain is broken")
                 return 1
+        if not history.read_history(hist_path):
+            print("demo history: no valid run_summary record")
+            return 1
         _, out_path = export.export_trace(d)
         with open(out_path, encoding="utf-8") as f:
             trace = json_mod.load(f)

@@ -1,0 +1,229 @@
+#!/usr/bin/env python3
+"""Run-history regression sentinel: robust trend detection over
+RUN_HISTORY.jsonl.
+
+Single-baseline compares (verify_perf's 15%-over-BENCH_BASELINE gate)
+catch step regressions but are blind to drift — five runs each 4%
+slower never trip a 15% bar, and one lucky baseline hides a real
+slowdown. The sentinel instead judges the NEWEST run of each workload
+group against the MEDIAN of the previous K runs, with a noise band
+from the MAD (median absolute deviation, the robust sigma: one
+outlier run cannot widen the band the way it would a stddev):
+
+    worse_by  = direction-signed (newest - median)
+    band      = max(rel_tol * |median|, mad_k * 1.4826 * MAD)
+    REGRESSION when worse_by > band
+
+Records compare only within a workload group (same `kind`, `rows`,
+`iterations`) — a 1M-row rung's train time says nothing about the
+100k rung's. Tracked metrics and their good direction:
+
+    train_s / serving_p99_ms / peak_memory_bytes /
+    collective_bytes_per_tree      lower is better
+    auc / comm_overlap_pct / prefetch_overlap_pct   higher is better
+
+Usage:
+    python tools/sentinel.py [RUN_HISTORY.jsonl] [--k 5]
+        [--rel-tol 0.15] [--mad-k 4.0] [--quiet]
+    python tools/sentinel.py --self-check
+
+Exit codes: 0 = no regression (or not enough history to judge),
+1 = regression flagged, 2 = usage / unreadable history. `--self-check`
+seeds synthetic histories (a clean one and one with an injected >20%
+train-time regression) and asserts the sentinel stays quiet on the
+first and trips on the second — the `make verify-obs` leg.
+"""
+
+import argparse
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lightgbm_tpu.telemetry import history as history_mod  # noqa: E402
+
+MAD_SCALE = 1.4826   # MAD -> sigma for normal noise
+
+# (field, direction, rel_tol override): "down" = lower is better.
+# Timing/memory metrics are noisy — they use the CLI-level rel_tol
+# (default 15%); accuracy and overlap move in much tighter bands, so a
+# 15% floor would mask real damage (an 8% AUC drop is a catastrophe,
+# not noise)
+TRACKED = (("train_s", "down", None),
+           ("serving_p99_ms", "down", None),
+           ("peak_memory_bytes", "down", None),
+           ("collective_bytes_per_tree", "down", 0.05),
+           ("auc", "up", 0.005),
+           ("comm_overlap_pct", "up", 0.05),
+           ("prefetch_overlap_pct", "up", 0.05))
+
+MIN_WINDOW = 3   # fewer prior runs than this -> no verdict
+
+
+def metric_value(rec, field):
+    v = rec.get(field)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        v = (rec.get("metrics") or {}).get(field)
+    return float(v) if isinstance(v, (int, float)) \
+        and not isinstance(v, bool) else None
+
+
+def group_key(rec):
+    # platform is part of the workload identity: a cpu rung's train
+    # time says nothing about the tpu rung's — mixing them makes a
+    # platform switch read as a huge regression (or mask a real one)
+    return (rec.get("kind"), rec.get("platform"), rec.get("rows"),
+            rec.get("iterations"))
+
+
+def assess(values, direction, k=5, rel_tol=0.15, mad_k=4.0):
+    """Judge values[-1] against the median of the up-to-k prior
+    values. Returns a verdict dict; verdict is one of "regression",
+    "improvement", "ok", "insufficient"."""
+    candidate = values[-1]
+    window = values[max(0, len(values) - 1 - k):-1]
+    if len(window) < MIN_WINDOW:
+        return {"verdict": "insufficient", "value": candidate,
+                "window": len(window)}
+    med = statistics.median(window)
+    mad = statistics.median(abs(v - med) for v in window)
+    band = max(rel_tol * abs(med), mad_k * MAD_SCALE * mad)
+    delta = candidate - med
+    worse_by = delta if direction == "down" else -delta
+    if band > 0 and worse_by > band:
+        verdict = "regression"
+    elif band > 0 and -worse_by > band:
+        verdict = "improvement"
+    else:
+        verdict = "ok"
+    return {"verdict": verdict, "value": candidate, "median": med,
+            "mad": mad, "band": band, "delta": delta,
+            "delta_pct": (100.0 * delta / abs(med) if med else 0.0),
+            "window": len(window)}
+
+
+def run_sentinel(path, k=5, rel_tol=0.15, mad_k=4.0):
+    """The trend report over one history file. Returns (exit_code,
+    report_lines): 0 clean, 1 regression, 2 unreadable/empty."""
+    records = history_mod.read_history(path)
+    if not records:
+        return 2, [f"sentinel: no run_summary records in {path}"]
+    groups = {}
+    for rec in records:
+        groups.setdefault(group_key(rec), []).append(rec)
+    lines = [f"sentinel: {len(records)} run(s) across "
+             f"{len(groups)} workload group(s) in {path}"]
+    regressed = False
+    for key, recs in sorted(groups.items(),
+                            key=lambda kv: str(kv[0])):
+        kind, platform, rows, iters = key
+        label = f"{kind} rows={rows} iters={iters}" \
+            + (f" [{platform}]" if platform else "")
+        judged = False
+        for field, direction, rel_override in TRACKED:
+            values = [v for v in (metric_value(r, field) for r in recs)
+                      if v is not None]
+            if len(values) < 2:
+                continue
+            res = assess(values, direction, k=k,
+                         rel_tol=(rel_override if rel_override
+                                  is not None else rel_tol),
+                         mad_k=mad_k)
+            if res["verdict"] == "insufficient":
+                continue
+            judged = True
+            arrow = {"down": "<=", "up": ">="}[direction]
+            mark = {"regression": "REGRESSION", "improvement":
+                    "improvement", "ok": "ok"}[res["verdict"]]
+            lines.append(
+                f"sentinel: [{label}] {field} {res['value']:g} vs "
+                f"median {res['median']:g} over last {res['window']} "
+                f"({res['delta_pct']:+.1f}%, band "
+                f"±{res['band']:g}, good {arrow} median) -> "
+                f"{mark}")
+            if res["verdict"] == "regression":
+                regressed = True
+        if not judged:
+            lines.append(f"sentinel: [{label}] {len(recs)} run(s) — "
+                         f"not enough history to judge "
+                         f"(need {MIN_WINDOW + 1})")
+    lines.append("sentinel: " + ("REGRESSION FLAGGED"
+                                 if regressed else "trend clean"))
+    return (1 if regressed else 0), lines
+
+
+def self_check():
+    """Seed synthetic histories; assert the sentinel trips on an
+    injected >20% train-time regression over a 5-run history and
+    stays quiet on the clean one."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="sentinel_check_")
+    try:
+        base = dict(kind="selfcheck", rows=100_000, iterations=10,
+                    auc=0.870)
+        clean_times = [2.00, 1.96, 2.03, 1.98, 2.01, 1.99]
+        clean = os.path.join(d, "clean.jsonl")
+        for t in clean_times:
+            history_mod.append_run_summary(clean, train_s=t, **base)
+        rc_clean, lines = run_sentinel(clean)
+        print("\n".join(lines))
+        bad = os.path.join(d, "regressed.jsonl")
+        for t in clean_times[:-1] + [2.00 * 1.25]:   # injected +25%
+            history_mod.append_run_summary(bad, train_s=t, **base)
+        rc_bad, lines = run_sentinel(bad)
+        print("\n".join(lines))
+        # and a quality regression: AUC falls off a stable history
+        drop = os.path.join(d, "auc_drop.jsonl")
+        for i, auc in enumerate([0.870, 0.871, 0.869, 0.870, 0.8]):
+            history_mod.append_run_summary(
+                drop, train_s=2.0, **dict(base, auc=auc))
+        rc_drop, lines = run_sentinel(drop)
+        print("\n".join(lines))
+        ok = (rc_clean == 0 and rc_bad == 1 and rc_drop == 1)
+        print("sentinel self-check:", "OK" if ok else
+              f"FAILED (clean rc={rc_clean}, regressed rc={rc_bad}, "
+              f"auc-drop rc={rc_drop})")
+        return 0 if ok else 1
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python tools/sentinel.py",
+        description="Run-history regression sentinel (median + MAD "
+                    "trend gate over RUN_HISTORY.jsonl)")
+    ap.add_argument("history", nargs="?",
+                    default=history_mod.default_path(
+                        os.path.dirname(os.path.dirname(
+                            os.path.abspath(__file__)))),
+                    help="history file (default: repo RUN_HISTORY.jsonl)")
+    ap.add_argument("--k", type=int, default=5,
+                    help="window of prior runs to trend over")
+    ap.add_argument("--rel-tol", type=float, default=0.15,
+                    help="relative noise floor vs the median")
+    ap.add_argument("--mad-k", type=float, default=4.0,
+                    help="MAD multiples the band widens to")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print only the verdict line")
+    ap.add_argument("--self-check", action="store_true",
+                    help="synthetic-history behavior check")
+    args = ap.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if not os.path.exists(args.history):
+        print(f"sentinel: no history at {args.history} "
+              "(nothing to judge)", file=sys.stderr)
+        return 2
+    rc, lines = run_sentinel(args.history, k=args.k,
+                             rel_tol=args.rel_tol, mad_k=args.mad_k)
+    print("\n".join(lines[-1:] if args.quiet else lines))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,7 +77,7 @@ def check_speed():
               f"{res['phases']['hist_bytes_per_s'] / 1e9:.2f} GB/s")
     ok_mem = check_memory(base, res)
     ok_quality = check_quality_overhead(res)
-    return ok_speed and ok_auc and ok_mem and ok_quality
+    return ok_speed and ok_auc and ok_mem and ok_quality, res
 
 
 def check_memory(base, res):
@@ -136,6 +136,39 @@ def check_quality_overhead(res):
               f"{'OK' if good else 'OVER BUDGET'}")
         ok = ok and good
     return ok
+
+
+def check_history(res):
+    """History-aware regression gate (tools/sentinel.py): append this
+    run's measurement to RUN_HISTORY.jsonl, then trend the file —
+    median + MAD over the last K comparable runs, so slow drift the
+    single-baseline gate can't see still fails loudly. With no (or
+    too-little) history the gate records and passes: the sentinel only
+    judges once >= 4 comparable runs exist."""
+    sys.path.insert(0, REPO)
+    from lightgbm_tpu.telemetry import history as history_mod
+    from tools.sentinel import run_sentinel
+
+    path = os.environ.get("VERIFY_HISTORY_PATH",
+                          os.path.join(REPO, "RUN_HISTORY.jsonl"))
+    intro = res.get("introspection") or {}
+    peak = intro.get("device_peak_bytes") or intro.get(
+        "host_peak_rss_bytes")
+    history_mod.append_run_summary(
+        path, "verify_perf", rows=int(res["n_rows"]),
+        iterations=int(res["n_iters"]), train_s=float(res["time_s"]),
+        auc=float(res["auc"]),
+        peak_memory_bytes=int(peak) if peak else None,
+        telemetry_overhead_pct=res["phases"].get(
+            "telemetry_overhead_pct"),
+        platform=res.get("platform"))
+    rc, lines = run_sentinel(path)
+    for line in lines:
+        print(f"verify-perf: {line}")
+    if rc == 2:
+        print("verify-perf: history unreadable -> sentinel skipped")
+        return True
+    return rc == 0
 
 
 def check_journal_tracer_consistency():
@@ -267,10 +300,19 @@ def check_dist():
         print(f"verify-dist: probe failed: {res['error']}")
         return False
     ok = True
+    vs_serial = res.get("rows_s_vs_serial")
     print(f"verify-dist: {res['rows']} rows x {res['iters']} iters, "
           f"{res['trees']} trees, sync wait {res['sync_wait_s']:.2f}s, "
           f"{res['rows_s']:.0f} rows/s "
-          f"({res['rows_s_vs_serial']:.2f}x serial)")
+          + (f"({vs_serial:.2f}x serial)" if vs_serial is not None
+             else "(serial baseline unavailable)"))
+    if res.get("comm_overlap_pct") is not None:
+        # the latency-side story next to the wire bytes (ISSUE 13):
+        # overlap + per-rank straggler deltas + the flow-event export
+        print(f"verify-dist: comm overlap {res['comm_overlap_pct']:.1f}%"
+              f", straggler deltas {res.get('comm_straggler_s')}, "
+              f"perfetto flow events {res.get('perfetto_flow_events')} "
+              f"(valid={res.get('perfetto_valid')})")
     bpt = res["collective_bytes_per_tree"]
     reduction = res["bytes_reduction_vs_allgather"]
     min_red = float(os.environ.get("VERIFY_DIST_MIN_REDUCTION", "3.0"))
@@ -426,7 +468,8 @@ def main():
             return 1
         print("verify-dist: all checks passed")
         return 0
-    ok = check_speed()
+    ok, res = check_speed()
+    ok = check_history(res) and ok
     ok = check_journal_tracer_consistency() and ok
     if not ok:
         print("verify-perf: FAILED")
