@@ -25,6 +25,24 @@ $(TARGET): src_native/c_api_shim.cpp
 test-capi: $(TARGET)
 	$(PYTHON) -m pytest tests/test_c_api.py -q
 
+# static-analysis gate (graftlint, lightgbm_tpu/analysis/ — docs/
+# Static-Analysis.md): first the fixture corpus self-check (every rule
+# must flag its known-bad snippets and stay silent on its known-good
+# ones), then the live tree, which must be clean modulo the committed,
+# justified baseline (tools/lint_baseline.json). Runs through the
+# jax-free tools/graftlint.py shim: stdlib-ast only, a few seconds,
+# no accelerator runtime
+GRAFTLINT_JSON ?= /tmp/graftlint-$(shell id -u).json
+
+verify-lint:
+	$(PYTHON) tools/graftlint.py --self-check
+	$(PYTHON) tools/graftlint.py --json $(GRAFTLINT_JSON)
+
+# the default CI aggregate: every verify target, cheapest gate first
+# (a lint violation fails in seconds, before any training run starts)
+verify: verify-lint verify-fault verify-serve verify-obs verify-quality \
+	verify-perf verify-ooc verify-fleet verify-dist verify-dist-perf
+
 # fault-injection suite: checkpoint/resume determinism, corrupt-snapshot
 # fallback, non-finite guardrails, distributed-init hardening
 verify-fault:
@@ -120,6 +138,6 @@ verify-ooc:
 clean:
 	rm -f $(TARGET)
 
-.PHONY: all test-capi verify-fault verify-dist verify-dist-perf \
-	verify-serve verify-obs verify-perf verify-quality verify-fleet \
-	verify-ooc clean
+.PHONY: all test-capi verify verify-lint verify-fault verify-dist \
+	verify-dist-perf verify-serve verify-obs verify-perf verify-quality \
+	verify-fleet verify-ooc clean

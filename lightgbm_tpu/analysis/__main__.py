@@ -1,0 +1,7 @@
+"""``python -m lightgbm_tpu.analysis`` — see cli.py / tools/graftlint.py."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,162 @@
+"""Package-wide call graph with guard-aware reachability.
+
+Built once per lint run and shared by the rules that need
+interprocedural facts (callback-in-mesh). Resolution is *name-based*:
+a call site ``foo(...)`` / ``x.foo(...)`` links to every function
+DEFINED as ``foo`` anywhere in the project. That over-approximates
+(aliasing, shadowing) — which is the right bias for a linter guarding
+against deadlocks: a false edge can only make the rule more demanding,
+and the pragma/baseline machinery absorbs reviewed false positives.
+
+Guard-awareness: a call edge whose call site is lexically inside a
+``with callbacks_disabled():`` / ``with meshed_trace_guard():`` block
+is a *guarded* edge — the trace-time guard makes ops/histogram.py's
+``chunk_mode()`` resolve "bincount" to the pure-XLA segment kernel, so
+host callbacks are unreachable through it (ops/histogram.py:154).
+Reachability of ``jax.pure_callback`` is computed over UNGUARDED edges
+only.
+"""
+
+import ast
+
+from .core import call_name
+
+# the trace-time guards that cut callback reachability (the watchdog's
+# collective_guard does NOT — it arms a timer, it doesn't change which
+# kernel is traced)
+CB_GUARDS = frozenset({"callbacks_disabled", "meshed_trace_guard"})
+
+# direct host-callback entry points (seeds)
+CALLBACK_CALLS = ("pure_callback", "io_callback")
+
+
+class FunctionInfo:
+    __slots__ = ("pf", "node", "name", "qual", "cls",
+                 "calls", "direct_callback")
+
+    def __init__(self, pf, node):
+        self.pf = pf
+        self.node = node
+        self.name = node.name
+        self.qual = f"{pf.rel}:{pf.qualname(node)}"
+        cls = pf.enclosing_class(node)
+        self.cls = cls.name if cls is not None else None
+        # [(dotted_name, cb_guarded, call_node)]
+        self.calls = []
+        self.direct_callback = False
+
+
+class CallGraph:
+    def __init__(self, project):
+        self.project = project
+        self.functions = []       # every FunctionInfo
+        self.by_name = {}         # simple def name -> [FunctionInfo]
+        self.by_node = {}         # id(ast node) -> FunctionInfo
+        self._build()
+        self._reaches_cb = None
+
+    def _build(self):
+        for pf in self.project.files:
+            for node in pf.functions():
+                fi = FunctionInfo(pf, node)
+                self.functions.append(fi)
+                self.by_name.setdefault(fi.name, []).append(fi)
+                self.by_node[id(node)] = fi
+        for fi in self.functions:
+            base_guards = getattr(fi.node, "_g_guards", frozenset())
+            for sub in ast.walk(fi.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                # attribute calls to the *nearest* enclosing function:
+                # nested defs own their call sites
+                owner = self._owning_function(sub)
+                if owner is not fi.node:
+                    continue
+                name = call_name(sub)
+                if not name:
+                    continue
+                last = name.rsplit(".", 1)[-1]
+                guards = getattr(sub, "_g_guards", frozenset())
+                # guards inherited from OUTSIDE the function don't
+                # guard the trace happening inside it at call time
+                local_guards = guards - base_guards
+                cb_guarded = bool(local_guards & CB_GUARDS)
+                fi.calls.append((name, cb_guarded, sub))
+                if last in CALLBACK_CALLS:
+                    fi.direct_callback = True
+
+    def _owning_function(self, node):
+        fn = getattr(node, "_g_func", None)
+        return fn
+
+    # ------------------------------------------------------ reachability
+
+    def reaches_callback(self):
+        """{FunctionInfo} from which a host callback is reachable over
+        unguarded call edges (fixpoint over the name-resolved graph)."""
+        if self._reaches_cb is not None:
+            return self._reaches_cb
+        reaches = {fi for fi in self.functions if fi.direct_callback}
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions:
+                if fi in reaches:
+                    continue
+                for name, cb_guarded, _ in fi.calls:
+                    if cb_guarded:
+                        continue
+                    last = name.rsplit(".", 1)[-1]
+                    for cand in self.by_name.get(last, ()):
+                        if cand in reaches:
+                            reaches.add(fi)
+                            changed = True
+                            break
+                    if fi in reaches:
+                        break
+        self._reaches_cb = reaches
+        return reaches
+
+    # ------------------------------------------------------- callers
+
+    def callers_of(self, name):
+        """[(caller FunctionInfo, cb_guarded, call node)] for call sites
+        whose last name segment is ``name``."""
+        out = []
+        for fi in self.functions:
+            for cname, cb_guarded, node in fi.calls:
+                if cname.rsplit(".", 1)[-1] == name:
+                    out.append((fi, cb_guarded, node))
+        return out
+
+    # -------------------------------------------------- class hierarchy
+
+    def hierarchy_of(self, cls_name):
+        """Names of every class connected to ``cls_name`` through
+        base-class links (either direction), name-resolved across the
+        project. The meshed-learner family guards its builder dispatch
+        in ONE base-class override; the whole family inherits it."""
+        edges = {}
+        for pf in self.project.files:
+            for cls in pf.classes():
+                bases = set()
+                for b in cls.bases:
+                    if isinstance(b, ast.Name):
+                        bases.add(b.id)
+                    elif isinstance(b, ast.Attribute):
+                        bases.add(b.attr)
+                edges.setdefault(cls.name, set()).update(bases)
+                for b in bases:
+                    edges.setdefault(b, set()).add(cls.name)
+        seen = set()
+        frontier = [cls_name]
+        while frontier:
+            cur = frontier.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            frontier.extend(edges.get(cur, ()))
+        return seen
+
+    def methods_of(self, cls_names):
+        return [fi for fi in self.functions if fi.cls in cls_names]

@@ -1,0 +1,8 @@
+"""Rule modules. Importing this package registers every rule
+(``@register`` in each module populates ``core.REGISTRY``). New rules:
+drop a module here, import it below, ship fixtures — see
+docs/Static-Analysis.md "Adding a rule"."""
+
+from . import (atomic_writes, callback_mesh, collectives, config_doc,
+               determinism, journal_schema, precision,
+               prom_naming)  # noqa: F401

@@ -1,0 +1,218 @@
+"""callback-in-mesh: host callbacks must not be traceable into
+multi-device shard_map programs without a trace-time guard.
+
+Provenance: host callbacks embedded in multi-device ``shard_map``
+programs deadlock this image's XLA CPU runtime — the dispatching
+thread blocks in a sharded execute while the callback worker threads
+park on the GIL it holds (ops/histogram.py:154 ``callbacks_disabled``,
+parallel/mesh.py:78 ``meshed_trace_guard``). The meshed learners must
+therefore TRACE their builders under one of those guards, which makes
+``chunk_mode()`` resolve "bincount" to the pure-XLA segment kernel.
+
+Static model (over-approximate by design; see docs/Static-Analysis.md):
+
+1. compute the set of functions from which ``jax.pure_callback`` /
+   ``io_callback`` is reachable over UNGUARDED call edges
+   (analysis/callgraph.py);
+2. find every ``shard_map(fn, ...)`` site whose traced ``fn`` resolves
+   to a callback-reaching function;
+3. such a site is GUARDED when any of
+   (a) the site itself is lexically under a guard ``with``;
+   (b) some call site of the function containing it (transitively,
+       over name-resolved callers) is under a guard ``with``;
+   (c) the containing class hierarchy guards its builder dispatch: a
+       method somewhere in the hierarchy wraps a call to another
+       hierarchy method in a guard ``with`` (the meshed-learner family
+       guards once in ``_MeshedTreeLearner.train_device`` and every
+       subclass inherits it);
+   otherwise it is flagged.
+
+Sites whose traced fn cannot be resolved (a parameter, a lambda from
+elsewhere) are skipped — the rule prefers silence to noise there; the
+dynamic deadlock still has the runtime caveat comments.
+"""
+
+import ast
+
+from ..callgraph import CB_GUARDS, CallGraph
+from ..core import Fixture, Rule, Severity, register
+
+
+def _is_shard_map_call(call, name):
+    return name.rsplit(".", 1)[-1] == "shard_map" or \
+        name.endswith("_exp_shard_map")
+
+
+@register
+class CallbackInMeshRule(Rule):
+    name = "callback-in-mesh"
+    doc = ("shard_map-traced program can reach jax.pure_callback "
+           "without callbacks_disabled()/meshed_trace_guard()")
+    severity = Severity.ERROR
+
+    def check(self, project):
+        graph = CallGraph(project)
+        reaches = graph.reaches_callback()
+        out = []
+        for fi in graph.functions:
+            for name, _, call in fi.calls:
+                if not _is_shard_map_call(call, name):
+                    continue
+                traced = self._traced_fn(graph, fi, call)
+                if traced is None or traced not in reaches:
+                    continue
+                if self._guarded(graph, fi, call):
+                    continue
+                out.append(self.violation(
+                    fi.pf, call,
+                    f"shard_map traces {traced.name!r}, which can reach "
+                    f"jax.pure_callback, and no callbacks_disabled()/"
+                    f"meshed_trace_guard() encloses the trace path — "
+                    f"host callbacks in multi-device shard_map programs "
+                    f"deadlock the XLA CPU runtime "
+                    f"(ops/histogram.py callbacks_disabled)"))
+        return out
+
+    # ------------------------------------------------------- resolution
+
+    def _traced_fn(self, graph, fi, call):
+        """FunctionInfo of the traced callable: first positional arg
+        (or ``fn=`` keyword), resolved as a Name against defs in the
+        same file first, then uniquely across the project."""
+        arg = None
+        if call.args:
+            arg = call.args[0]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "fn":
+                    arg = kw.value
+        if not isinstance(arg, ast.Name):
+            return None
+        cands = [c for c in graph.by_name.get(arg.id, ())
+                 if c.pf is fi.pf]
+        if not cands:
+            cands = graph.by_name.get(arg.id, [])
+        # ambiguous resolution (same name defined more than once at the
+        # chosen scope) would attribute an arbitrary function's
+        # callback-reachability — skip instead (silence over noise)
+        return cands[0] if len(cands) == 1 else None
+
+    # ----------------------------------------------------------- guards
+
+    def _guarded(self, graph, fi, call):
+        # (a) lexical guard at the trace site, or at the dispatch of
+        # the shard_map result (tracing happens at first CALL of the
+        # wrapped fn, so `fn = shard_map(...); with guard(): fn(x)`
+        # is the common guarded shape)
+        if getattr(call, "_g_guards", frozenset()) & CB_GUARDS:
+            return True
+        parent = getattr(call, "_g_parent", None)
+        while isinstance(parent, ast.Call):   # jax.jit(shard_map(...))
+            parent = getattr(parent, "_g_parent", None)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            target = parent.targets[0].id
+            for sub in ast.walk(fi.node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Name) \
+                        and sub.func.id == target \
+                        and getattr(sub, "_g_guards",
+                                    frozenset()) & CB_GUARDS:
+                    return True
+        # (b) a caller chain wraps the containing function in a guard
+        seen = set()
+        frontier = {fi.node.name}
+        for _ in range(8):   # bounded caller-chain walk
+            next_frontier = set()
+            for name in frontier:
+                if name in seen:
+                    continue
+                seen.add(name)
+                for caller, cb_guarded, _node in graph.callers_of(name):
+                    if cb_guarded:
+                        return True
+                    next_frontier.add(caller.node.name)
+            if not next_frontier - seen:
+                break
+            frontier = next_frontier
+        # (c) the class hierarchy guards its dispatch somewhere
+        if fi.cls is not None:
+            hier = graph.hierarchy_of(fi.cls)
+            method_names = {m.name for m in graph.methods_of(hier)}
+            for m in graph.methods_of(hier):
+                for name, cb_guarded, _node in m.calls:
+                    if cb_guarded and \
+                            name.rsplit(".", 1)[-1] in method_names:
+                        return True
+        return False
+
+    # --------------------------------------------------------- fixtures
+
+    def fixtures(self):
+        common = {
+            "lightgbm_tpu/ops/kern.py": (
+                "import jax\n"
+                "def chunk_kernel(x):\n"
+                "    return jax.pure_callback(lambda a: a, x, x)\n"
+            ),
+        }
+        bad = dict(common)
+        bad["lightgbm_tpu/parallel/newlearner.py"] = (
+            "import jax\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "from ..ops.kern import chunk_kernel\n"
+            "def build(bins):\n"
+            "    return chunk_kernel(bins)\n"
+            "def train(mesh, bins):\n"
+            "    fn = shard_map(build, mesh=mesh, in_specs=None,\n"
+            "                   out_specs=None)\n"
+            "    return fn(bins)\n"
+        )
+        good = dict(common)
+        good["lightgbm_tpu/parallel/newlearner.py"] = (
+            "import jax\n"
+            "from jax.experimental.shard_map import shard_map\n"
+            "from .mesh import meshed_trace_guard\n"
+            "from ..ops.kern import chunk_kernel\n"
+            "def build(bins):\n"
+            "    return chunk_kernel(bins)\n"
+            "def train(mesh, bins):\n"
+            "    fn = shard_map(build, mesh=mesh, in_specs=None,\n"
+            "                   out_specs=None)\n"
+            "    with meshed_trace_guard():\n"
+            "        return fn(bins)\n"
+        )
+        # guard applied one caller up the chain, not at the site
+        good_caller = dict(common)
+        good_caller["lightgbm_tpu/parallel/newlearner.py"] = (
+            "from jax.experimental.shard_map import shard_map\n"
+            "from .mesh import meshed_trace_guard\n"
+            "from ..ops.kern import chunk_kernel\n"
+            "def build(bins):\n"
+            "    return chunk_kernel(bins)\n"
+            "def dispatch(mesh, bins):\n"
+            "    fn = shard_map(build, mesh=mesh, in_specs=None,\n"
+            "                   out_specs=None)\n"
+            "    return fn(bins)\n"
+            "def train(mesh, bins):\n"
+            "    with meshed_trace_guard():\n"
+            "        return dispatch(mesh, bins)\n"
+        )
+        # traced fn holds no callback path at all -> nothing to flag
+        good_nocb = {
+            "lightgbm_tpu/parallel/newlearner.py": (
+                "from jax.experimental.shard_map import shard_map\n"
+                "def build(bins):\n"
+                "    return bins + 1\n"
+                "def train(mesh, bins):\n"
+                "    fn = shard_map(build, mesh=mesh, in_specs=None,\n"
+                "                   out_specs=None)\n"
+                "    return fn(bins)\n"
+            ),
+        }
+        return [
+            Fixture("unguarded-mesh-callback", bad, expect=1),
+            Fixture("guarded-at-site", good, expect=0),
+            Fixture("guarded-in-caller", good_caller, expect=0),
+            Fixture("no-callback-path", good_nocb, expect=0),
+        ]

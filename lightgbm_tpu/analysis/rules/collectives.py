@@ -1,0 +1,121 @@
+"""unguarded-collective: blocking device syncs in parallel paths must
+be armed by ``collective_guard``.
+
+Provenance: the collective watchdog (parallel/heartbeat.py
+``collective_guard`` / ``CollectiveWatchdog.armed``) only sees syncs
+it brackets — an unguarded blocking sync in a parallel path means a
+dead/straggling peer wedges the process with the watchdog blind (no
+named abort, no straggler attribution, exit-117 path never fires), and
+since PR 12 an unguarded sync is also invisible to the comm profiler's
+wait/overlap accounting even on healthy runs.
+
+Scope: ``lightgbm_tpu/{parallel,models,data}/`` — the modules that run
+training-path device programs. Flagged sync calls:
+``jax.block_until_ready(...)`` / ``x.block_until_ready()``,
+``jax.device_get(...)``, and zero-arg ``.item()`` (a scalar device
+pull). A call is fine when lexically inside ``with
+collective_guard(...)`` / ``WATCHDOG.armed(...)`` (any with-item).
+``np.asarray`` on device values is a sync too but indistinguishable
+from host-array plumbing statically — the rule stays silent there and
+the guard-at-the-enclosing-sync discipline covers it in practice.
+"""
+
+import ast
+import re
+
+from ..core import Fixture, Rule, Severity, register
+
+SCOPE_RE = re.compile(r"^lightgbm_tpu/(parallel|models|data)/")
+SYNC_GUARDS = frozenset({"collective_guard", "armed"})
+SYNC_LAST = frozenset({"block_until_ready", "device_get", "item"})
+
+
+@register
+class UnguardedCollectiveRule(Rule):
+    name = "unguarded-collective"
+    doc = ("blocking device sync in a parallel path outside "
+           "collective_guard — watchdog/straggler attribution is blind "
+           "to it")
+    severity = Severity.ERROR
+
+    def check(self, project):
+        out = []
+        for pf in project.files:
+            if not SCOPE_RE.match(pf.rel):
+                continue
+            if pf.rel.endswith("parallel/heartbeat.py"):
+                continue  # the guard machinery itself
+            for call in pf.calls():
+                name = self._sync_name(pf, call)
+                if name is None:
+                    continue
+                if getattr(call, "_g_guards", frozenset()) & SYNC_GUARDS:
+                    continue
+                out.append(self.violation(
+                    pf, call,
+                    f"blocking device sync {name!r} outside "
+                    f"collective_guard — wrap it so the watchdog can "
+                    f"name a hang and the comm profiler can attribute "
+                    f"the wait (parallel/heartbeat.py)"))
+        return out
+
+    def _sync_name(self, pf, call):
+        from ..core import call_name
+        name = call_name(call)
+        if not name:
+            return None
+        last = name.rsplit(".", 1)[-1]
+        if last not in SYNC_LAST:
+            return None
+        if last == "item":
+            # zero-arg method call: the device-scalar pull shape
+            # (dict.items() is 'items', so it never matches here)
+            if call.args or call.keywords or \
+                    not isinstance(call.func, ast.Attribute):
+                return None
+        if last in ("device_get", "block_until_ready"):
+            # jax.device_get / jax.block_until_ready / x.block_until_ready()
+            if last == "device_get" and not name.startswith("jax."):
+                return None
+        return name
+
+    def fixtures(self):
+        bad = {
+            "lightgbm_tpu/parallel/sync.py": (
+                "import jax\n"
+                "def fetch(out):\n"
+                "    host = jax.device_get(out)\n"
+                "    jax.block_until_ready(host)\n"
+                "    return out['n'].item()\n"
+            ),
+        }
+        good = {
+            "lightgbm_tpu/parallel/sync.py": (
+                "import jax\n"
+                "from .heartbeat import collective_guard\n"
+                "def fetch(out):\n"
+                "    with collective_guard('leaf_value_fetch'):\n"
+                "        host = jax.device_get(out)\n"
+                "        jax.block_until_ready(host)\n"
+                "        return out['n'].item()\n"
+            ),
+        }
+        out_of_scope = {
+            "lightgbm_tpu/serving/sync.py": (
+                "import jax\n"
+                "def fetch(out):\n"
+                "    return jax.device_get(out)\n"
+            ),
+        }
+        not_sync = {
+            "lightgbm_tpu/models/clean.py": (
+                "def walk(d):\n"
+                "    return sorted(d.items())\n"
+            ),
+        }
+        return [
+            Fixture("unguarded-syncs", bad, expect=3),
+            Fixture("guarded-syncs", good, expect=0),
+            Fixture("serving-out-of-scope", out_of_scope, expect=0),
+            Fixture("dict-items-not-flagged", not_sync, expect=0),
+        ]

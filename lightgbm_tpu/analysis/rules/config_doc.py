@@ -1,0 +1,203 @@
+"""config-doc-drift: every knob in config.py must have a
+docs/Parameters.md row and at least one read site.
+
+Provenance: PRs 6-12 added ~30 knobs by hand, each time editing three
+places — the ``Config`` dataclass, the Parameters table, and the code
+that reads the knob. Drift modes this rule catches:
+
+- a knob with no Parameters.md row (users can't discover it);
+- a knob no code ever reads (``cfg.<name>`` attribute access or
+  ``getattr(cfg, "<name>")`` anywhere outside the Config class body) —
+  either dead, or its wiring was lost in a refactor;
+- (warning) a Parameters.md row naming a knob that doesn't exist in
+  config.py — rows marked ``*(serving)*`` are serve-CLI flags with no
+  Config field by design and are exempt.
+
+Derived (non-knob) Config fields carry an inline
+``# graftlint: disable=config-doc-drift`` pragma in config.py.
+"""
+
+import ast
+import os
+import re
+
+from ..core import Fixture, Rule, Severity, call_name, register
+
+CONFIG_REL = "lightgbm_tpu/config.py"
+PARAMS_REL = "docs/Parameters.md"
+_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`(?P<rest>[^|]*)\|", re.M)
+
+
+def config_fields(pf):
+    """[(name, lineno)] of Config dataclass AnnAssign fields."""
+    for node in pf.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            return [(s.target.id, s.lineno) for s in node.body
+                    if isinstance(s, ast.AnnAssign)
+                    and isinstance(s.target, ast.Name)], node
+    return [], None
+
+
+def doc_rows(text):
+    """{name: is_cli_only} from Parameters.md table rows. Rows whose
+    first cell carries a ``*(serving)*`` marker are serve-CLI flags."""
+    rows = {}
+    for m in _ROW_RE.finditer(text):
+        rows[m.group(1)] = "(serving)" in m.group("rest")
+    return rows
+
+
+@register
+class ConfigDocDriftRule(Rule):
+    name = "config-doc-drift"
+    doc = ("config.py knob without a docs/Parameters.md row or without "
+           "any read site")
+    severity = Severity.ERROR
+
+    def check(self, project):
+        cfg = project.get(CONFIG_REL)
+        if cfg is None:
+            return []
+        fields, cls_node = config_fields(cfg)
+        if not fields:
+            return []
+        params_path = None
+        cand = os.path.join(project.root, PARAMS_REL)
+        if os.path.exists(cand):
+            params_path = cand
+        rows = {}
+        if params_path:
+            with open(params_path, "r", encoding="utf-8") as f:
+                rows = doc_rows(f.read())
+
+        reads = self._read_sites(project, cfg, cls_node,
+                                 {name for name, _ in fields})
+        out = []
+
+        class _Loc:
+            def __init__(self, lineno):
+                self.lineno = lineno
+                self._g_func = None
+
+        for name, lineno in fields:
+            if params_path and name not in rows:
+                out.append(self.violation(
+                    cfg, _Loc(lineno),
+                    f"knob {name!r} has no row in docs/Parameters.md — "
+                    f"every key=value parameter must be documented "
+                    f"there"))
+            if name not in reads:
+                out.append(self.violation(
+                    cfg, _Loc(lineno),
+                    f"knob {name!r} is never read (no `.{name}` "
+                    f"attribute access or getattr(_, '{name}') outside "
+                    f"the Config class) — dead knob or lost wiring"))
+        field_names = {name for name, _ in fields}
+        for row, cli_only in sorted(rows.items()):
+            if row not in field_names and not cli_only:
+                out.append(self.violation(
+                    cfg, _Loc(1),
+                    f"docs/Parameters.md documents {row!r} but "
+                    f"config.py has no such knob (stale row? mark "
+                    f"serve-CLI-only flags with *(serving)*)",
+                    severity=Severity.WARNING))
+        return out
+
+    def _read_sites(self, project, cfg_pf, cls_node, names):
+        """Knob names with >=1 read: attribute access ``x.<name>`` or
+        ``getattr(x, "<name>")`` anywhere in the project except the
+        Config class body (validate()/check_param_conflict() reading
+        their own fields is bookkeeping, not wiring)."""
+        cls_range = (cls_node.lineno, cls_node.end_lineno) \
+            if cls_node is not None else (0, -1)
+        reads = set()
+        for pf in project.files:
+            if pf.rel.startswith(("tests/", "lightgbm_tpu/analysis/")):
+                continue   # tests/fixtures don't count as wiring
+            for node in ast.walk(pf.tree):
+                in_cfg_cls = (pf is cfg_pf
+                              and cls_range[0] <= getattr(node, "lineno", 0)
+                              <= cls_range[1])
+                if in_cfg_cls:
+                    continue
+                if isinstance(node, ast.Attribute) and node.attr in names \
+                        and isinstance(node.ctx, ast.Load):
+                    reads.add(node.attr)
+                elif isinstance(node, ast.Call):
+                    if call_name(node) == "getattr" and \
+                            len(node.args) >= 2 and \
+                            isinstance(node.args[1], ast.Constant) and \
+                            node.args[1].value in names:
+                        reads.add(node.args[1].value)
+        return reads
+
+    def fixtures(self):
+        doc = ("# Parameters\n\n"
+               "| Parameter | Default | Aliases |\n"
+               "|---|---|---|\n"
+               "| `num_leaves` | 127 |  |\n"
+               "| `serving_precision` *(serving)* | f32 |  |\n")
+        bad = {
+            "lightgbm_tpu/config.py": (
+                "from dataclasses import dataclass\n"
+                "@dataclass\n"
+                "class Config:\n"
+                "    num_leaves: int = 127\n"
+                "    mystery_knob: int = 0\n"
+            ),
+            "docs/Parameters.md": doc,
+            "lightgbm_tpu/engine.py": (
+                "def train(cfg):\n"
+                "    return cfg.num_leaves\n"
+            ),
+        }
+        good = {
+            "lightgbm_tpu/config.py": (
+                "from dataclasses import dataclass\n"
+                "@dataclass\n"
+                "class Config:\n"
+                "    num_leaves: int = 127\n"
+            ),
+            "docs/Parameters.md": doc,
+            "lightgbm_tpu/engine.py": (
+                "def train(cfg):\n"
+                "    return cfg.num_leaves\n"
+            ),
+        }
+        bad_stale_row = {
+            "lightgbm_tpu/config.py": (
+                "from dataclasses import dataclass\n"
+                "@dataclass\n"
+                "class Config:\n"
+                "    num_leaves: int = 127\n"
+            ),
+            "docs/Parameters.md": doc + "| `retired_knob` | 1 |  |\n",
+            "lightgbm_tpu/engine.py": (
+                "def train(cfg):\n"
+                "    return cfg.num_leaves\n"
+            ),
+        }
+        good_pragma = {
+            "lightgbm_tpu/config.py": (
+                "from dataclasses import dataclass\n"
+                "@dataclass\n"
+                "class Config:\n"
+                "    num_leaves: int = 127\n"
+                "    # derived, not a user knob\n"
+                "    is_parallel: bool = False  "
+                "# graftlint: disable=config-doc-drift\n"
+            ),
+            "docs/Parameters.md": doc,
+            "lightgbm_tpu/engine.py": (
+                "def train(cfg):\n"
+                "    return cfg.num_leaves and cfg.is_parallel\n"
+            ),
+        }
+        return [
+            # mystery_knob: no doc row AND no read site -> 2
+            Fixture("undocumented-unread-knob", bad, expect=2),
+            Fixture("documented-read-knob", good, expect=0),
+            Fixture("stale-doc-row", bad_stale_row, expect=1),
+            # the derived field's missing doc row is pragma-suppressed
+            Fixture("derived-field-pragma", good_pragma, expect=0),
+        ]

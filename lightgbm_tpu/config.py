@@ -393,9 +393,10 @@ class Config:
     # verify each block's manifest digest on its first read
     ooc_verify: bool = True
 
-    # derived
-    is_parallel: bool = False
-    is_parallel_find_bin: bool = False
+    # derived from tree_learner/num_machines in check_param_conflict,
+    # not user knobs — exempt from the Parameters.md row requirement
+    is_parallel: bool = False  # graftlint: disable=config-doc-drift
+    is_parallel_find_bin: bool = False  # graftlint: disable=config-doc-drift
 
     # TPU-specific knobs (no reference equivalent)
     device_row_chunk: int = 16384  # rows per histogram-matmul chunk

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from ..ops.histogram import (callbacks_disabled, hist_pair_fold_block,
                              hist_pair_fold_collapse, set_hist_mode)
 from ..ops.split import K_MIN_SCORE, SplitParams, find_best_split
+from ..parallel.heartbeat import collective_guard
 from ..utils.log import Log
 from .prefetch import BlockPrefetcher
 
@@ -222,8 +223,12 @@ class OutOfCoreTreeLearner:
             for s, e, blk in self._prefetcher.stream():
                 acc, comp = self._fold(acc, comp, blk, ghc_dev[:, s:e],
                                        rl_dev[s:e], lid)
-            hist = jax.block_until_ready(
-                hist_pair_fold_collapse(acc, comp))
+            # the collapse wait is a blocking device sync: arm the
+            # watchdog + wait attribution around it like every other
+            # sync point (the guard is a no-op when disarmed/unbound)
+            with collective_guard("ooc:hist_fold"):
+                hist = jax.block_until_ready(
+                    hist_pair_fold_collapse(acc, comp))
         self._prefetcher.note_pass_wall(time.perf_counter() - t0)
         return hist
 
@@ -250,7 +255,8 @@ class OutOfCoreTreeLearner:
     def _eval_split(self, hist, sum_g, sum_h, cnt, fmask):
         out = self._eval(hist, F32(sum_g), F32(sum_h), F32(cnt), fmask,
                          self._num_bin_pf, self._is_cat_dev)
-        return jax.device_get(out)
+        with collective_guard("ooc:split_eval"):
+            return jax.device_get(out)
 
     def train_device(self, grad, hess, inbag=None):
         """Grow one tree, streaming the bin matrix per histogram pass.
@@ -293,7 +299,9 @@ class OutOfCoreTreeLearner:
         rl = np.zeros(n_pad, dtype=np.int32)
         rl_dev = jnp.asarray(rl)
         hist_root = self._leaf_hist(0, ghc_dev, rl_dev)
-        root_g, root_h, root_c = jax.device_get(self._root_sums(hist_root))
+        with collective_guard("ooc:root_sums"):
+            root_g, root_h, root_c = jax.device_get(
+                self._root_sums(hist_root))
         root_split = self._eval_split(hist_root, root_g, root_h, root_c,
                                       fmask)
 
@@ -429,8 +437,9 @@ class OutOfCoreTreeLearner:
 
     # ------------------------------------------------------ tree conversion
     def _to_host_tree(self, out, shrink=1.0):
-        host = jax.device_get({k: v for k, v in out.items()
-                               if k != "row_leaf"})
+        with collective_guard("tree_host_fetch"):
+            host = jax.device_get({k: v for k, v in out.items()
+                                   if k != "row_leaf"})
         return self.host_out_to_tree(host, shrink)
 
     def host_out_to_tree(self, host, shrink=1.0):

@@ -856,8 +856,18 @@ class SerialTreeLearner:
         return tree, out["row_leaf"][:self.num_data], out["leaf_value"]
 
     def _to_host_tree(self, out, shrink=1.0) -> Tree:
-        """ONE batched device->host transfer, then vectorized conversion."""
-        host = jax.device_get({k: v for k, v in out.items() if k != "row_leaf"})
+        """ONE batched device->host transfer, then vectorized conversion.
+
+        With jax's async dispatch this fetch is the FIRST blocking sync
+        after the (guarded) builder launch — for the meshed learners a
+        dead peer wedges the process right here, so the watchdog must
+        bracket it (graftlint unguarded-collective; the guard is
+        zero-overhead unarmed and feeds sync_wait_s when a timing sink
+        is bound)."""
+        from ..parallel.heartbeat import collective_guard
+        with collective_guard("tree_host_fetch"):
+            host = jax.device_get(
+                {k: v for k, v in out.items() if k != "row_leaf"})
         return self.host_out_to_tree(host, shrink)
 
     def host_out_to_tree(self, host, shrink=1.0) -> Tree:

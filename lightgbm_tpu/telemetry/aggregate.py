@@ -390,16 +390,22 @@ def main(argv=None):
     ap.add_argument("targets", nargs="+",
                     help="scrape targets, [role=]host:port "
                          "(role: train|serve|auto)")
-    ap.add_argument("--port", type=int, default=0,
-                    help="bind port for /fleetz + /metricz "
-                         "(0 = ephemeral; the `aggregate_port` "
-                         "parameter documents the convention)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="bind port for /fleetz + /metricz (default: "
+                         "the `aggregate_port` config knob, 0 = "
+                         "ephemeral)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--poll-s", type=float, default=2.0)
     ap.add_argument("--timeout-s", type=float, default=5.0)
     ap.add_argument("--once", action="store_true",
                     help="poll once, print the merged JSON, exit")
     args = ap.parse_args(argv)
+    if args.port is None:
+        # the `aggregate_port` knob is the documented default for this
+        # CLI (config.py); imported lazily — Config is jax-free but
+        # pulls numpy, which --help shouldn't need
+        from ..config import Config
+        args.port = int(Config().aggregate_port)
     try:
         agg = FleetAggregator(args.targets, poll_s=args.poll_s,
                               timeout_s=args.timeout_s)

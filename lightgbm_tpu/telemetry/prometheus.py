@@ -205,6 +205,30 @@ def render_multi(parts, prefix="lightgbm_tpu"):
     return _emit(merged)
 
 
+def lint_family_name(base, kind=None):
+    """Violation strings for ONE family name against the naming
+    contract (empty = conformant). The per-name core of `lint_names`,
+    and the SINGLE implementation graftlint's `prometheus-naming`
+    static rule imports (lightgbm_tpu/analysis/rules/prom_naming.py) —
+    the runtime page audit and the static literal audit cannot
+    diverge because they are the same function."""
+    if not base.startswith("lightgbm_tpu_"):
+        return [f"{base!r} lacks the lightgbm_tpu_ prefix"]
+    violations = []
+    if not re.fullmatch(r"[a-z][a-z0-9_]*", base) or "__" in base:
+        violations.append(
+            f"{base!r} is not lowercase [a-z0-9_] without __ runs")
+    for suffix in _LEGACY_SUFFIXES:
+        if base.endswith(suffix):
+            violations.append(
+                f"{base!r} ends with legacy unit suffix {suffix!r} "
+                "(use _seconds/_bytes/_ratio/_total)")
+            break
+    if kind == "counter" and not base.endswith("_total"):
+        violations.append(f"counter {base!r} must end _total")
+    return violations
+
+
 def lint_names(text):
     """Audit one exposition page against the naming contract. Returns
     a list of violation strings (empty = conformant):
@@ -215,6 +239,9 @@ def lint_names(text):
       ...) — times must be `_seconds`, fractions `_ratio`;
     - every `counter` family ends `_total`;
     - no duplicate samples, and every sample parses.
+
+    Per-family checks are `lint_family_name`; this adds the page-level
+    ones (duplicates, summary sub-series attribution).
     """
     violations = []
     kinds = {}
@@ -238,24 +265,8 @@ def lint_names(text):
             if base.endswith(sub) and base[: -len(sub)] in kinds:
                 base = base[: -len(sub)]
                 break
-        if not base.startswith("lightgbm_tpu_"):
-            violations.append(
-                f"line {lineno}: {base!r} lacks the lightgbm_tpu_ prefix")
-            continue
-        if not re.fullmatch(r"[a-z][a-z0-9_]*", base) or "__" in base:
-            violations.append(
-                f"line {lineno}: {base!r} is not lowercase [a-z0-9_] "
-                "without __ runs")
-        for suffix in _LEGACY_SUFFIXES:
-            if base.endswith(suffix):
-                violations.append(
-                    f"line {lineno}: {base!r} ends with legacy unit "
-                    f"suffix {suffix!r} (use _seconds/_bytes/_ratio/"
-                    "_total)")
-                break
-        if kinds.get(base) == "counter" and not base.endswith("_total"):
-            violations.append(
-                f"line {lineno}: counter {base!r} must end _total")
+        violations.extend(f"line {lineno}: {v}"
+                          for v in lint_family_name(base, kinds.get(base)))
     return violations
 
 

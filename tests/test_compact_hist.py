@@ -115,6 +115,18 @@ def test_compacted_shard_reduction_matches_serial():
     serial_full = jax.jit(lambda rl, l: masked_histograms_xla(
         bins, ghc_t, rl, l, b))
 
+    # trace the multi-device programs under callbacks_disabled like the
+    # meshed learners do: compacted_histograms' CPU-default bincount
+    # formulation is a host callback, and host callbacks inside
+    # multi-device shard_map programs can deadlock the XLA CPU runtime
+    # (ops/histogram.py:154; the chunk kernels are bit-identical across
+    # formulations, so the parity being tested is unchanged)
+    from lightgbm_tpu.ops.histogram import callbacks_disabled
+    with callbacks_disabled():
+        # leaf is a traced operand, so one call traces each program
+        sharded_c(bins, ghc_t, row_leaf, jnp.int32(0))
+        sharded_m(bins, ghc_t, row_leaf, jnp.int32(0))
+
     for leaf in range(leaves):
         hd = np.asarray(sharded_c(bins, ghc_t, row_leaf, jnp.int32(leaf)))
         ms, mc = sharded_m(bins, ghc_t, row_leaf, jnp.int32(leaf))
