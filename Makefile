@@ -41,7 +41,8 @@ verify-lint:
 # the default CI aggregate: every verify target, cheapest gate first
 # (a lint violation fails in seconds, before any training run starts)
 verify: verify-lint verify-fault verify-serve verify-obs verify-quality \
-	verify-perf verify-ooc verify-fleet verify-dist verify-dist-perf
+	verify-perf verify-ooc verify-fleet verify-resilience verify-dist \
+	verify-dist-perf
 
 # fault-injection suite: checkpoint/resume determinism, corrupt-snapshot
 # fallback, non-finite guardrails, distributed-init hardening
@@ -135,9 +136,25 @@ verify-ooc:
 	  tests/test_out_of_core.py -q
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --ooc
 
+# front-door resilience suite (docs/Resilience.md): deadline
+# propagation + queue shedding + brownout, chaos-fault determinism,
+# circuit-breaker state machine, retry/hedge budgets, plus the slow
+# chaos rung (3 replicas behind the router; one killed mid-traffic,
+# one slowed 10x — zero 5xx to well-deadlined clients, amplification
+# capped). Then the acceptance guard (bench router_probe via
+# tools/verify_perf.py --router: 150 qps through the router with a
+# kill + slowdown + error burst; zero 5xx/transport errors,
+# amplification <= 1.05, breaker opens AND re-closes, p99-under-chaos
+# gated against steady-state and BENCH_BASELINE.json)
+verify-resilience:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_resilience.py -q \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --router
+
 clean:
 	rm -f $(TARGET)
 
 .PHONY: all test-capi verify verify-lint verify-fault verify-dist \
 	verify-dist-perf verify-serve verify-obs verify-perf verify-quality \
-	verify-fleet verify-ooc clean
+	verify-fleet verify-ooc verify-resilience clean

@@ -970,6 +970,148 @@ def fleet_probe(timeout_s=300):
     return out
 
 
+def router_probe(timeout_s=240):
+    """Front-door resilience probe (docs/Resilience.md): three
+    in-process serving replicas behind the fleet router
+    (fleet/router.py), sustained deadlined QPS from the fleet load
+    generator; mid-run one replica is KILLED, another is slowed ~10x,
+    and a third takes a transient 100% error burst (so the breaker
+    visibly opens AND re-closes). Reports `router.steady_p99_ms` /
+    `p99_under_chaos_ms` / `shed_rate` / `error_amplification` plus
+    the breaker/retry/eject counters. tools/verify_perf.py --router
+    gates: zero 5xx to well-deadlined clients, amplification <= 1.05x,
+    chaos p99 within a pinned multiple of steady p99."""
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.fleet.loadgen import LoadGenerator
+    from lightgbm_tpu.fleet.router import make_router_server
+    from lightgbm_tpu.serving import CompiledPredictor, make_server
+
+    out = {}
+    replicas, rsrv = [], None
+    deadline = time.time() + timeout_s
+    try:
+        # the model only shapes the serving cost (8-row predicts); a
+        # small training set keeps the probe's setup under the masked
+        # learner's fast path so the chaos window, not the train,
+        # dominates wall clock
+        n = int(os.environ.get("BENCH_ROUTER_ROWS", "4000"))
+        x, y = make_data(n)
+        params = {"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 20, "verbose": -1}
+        _mark(f"router probe: training serving model ({n} rows)")
+        booster = lgb.train(dict(params),
+                            lgb.Dataset(x, y, params=dict(params)),
+                            num_boost_round=5, verbose_eval=False)
+        for _ in range(3):
+            pred = CompiledPredictor.from_booster(booster.gbdt,
+                                                  max_batch_rows=256)
+            srv = make_server(pred, port=0, max_wait_ms=1.0)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            replicas.append(srv)
+        targets = [f"127.0.0.1:{s.server_address[1]}" for s in replicas]
+        rsrv = make_router_server(targets, port=0, breaker_failures=3,
+                                  breaker_reset_s=0.5, retry_budget=1.0,
+                                  health_poll_s=0.2)
+        threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{rsrv.server_address[1]}"
+        qps = float(os.environ.get("BENCH_ROUTER_QPS", "150"))
+        duration = min(float(os.environ.get("BENCH_ROUTER_DURATION_S",
+                                            "6")),
+                       max(3.0, deadline - time.time() - 60))
+        deadline_ms = float(os.environ.get("BENCH_ROUTER_DEADLINE_MS",
+                                           "2000"))
+        slow_ms = float(os.environ.get("BENCH_ROUTER_SLOW_MS", "50"))
+        rows_per_req = 8
+        batches = [np.ascontiguousarray(x[i * rows_per_req:
+                                          (i + 1) * rows_per_req],
+                                        dtype=np.float32)
+                   for i in range(8)]
+        _mark(f"router probe: {qps:.0f} qps x {duration:.0f}s through "
+              f"the router, chaos mid-run (kill + {slow_ms:.0f}ms slow "
+              "+ error burst)")
+        gen = LoadGenerator(url, batches, qps=qps, workers=8,
+                            duration_s=duration, timeout_s=10.0,
+                            deadline_ms=deadline_ms)
+        gen.run(background=True)
+        time.sleep(duration * 0.35)            # steady state first
+        gen.mark_start("chaos")
+        dead = replicas[2]                     # hard death, mid-traffic
+        dead.shutdown()
+        dead.server_close()
+        dead.batcher.close()
+        replicas[0].chaos["slow_replica_ms"] = slow_ms   # ~10x typical
+        replicas[1].chaos["error_rate"] = 100  # transient total outage
+        time.sleep(0.75)
+        del replicas[1].chaos["error_rate"]    # burst over: breaker
+        time.sleep(max(0.0, duration * 0.35 - 0.75))  # must re-close
+        gen.mark_end("chaos")
+        gen.join(timeout=max(30.0, duration * 3))
+        rep = gen.report(swap_mark="chaos")
+        snap = rsrv.router.snapshot()
+        refusals = sum(c for s, c in rep["status_counts"].items()
+                       if s in (429, 503, 504))
+        out.update({
+            "requests": rep["requests"],
+            "achieved_qps": rep.get("achieved_qps", 0.0),
+            "status_counts": {str(k): v for k, v
+                              in sorted(rep["status_counts"].items())},
+            "server_errors_5xx": rep["server_errors_5xx"],
+            "transport_errors": rep["status_counts"].get(0, 0),
+            "steady_p50_ms": rep.get("steady_p50_ms", 0.0),
+            "steady_p99_ms": rep.get("steady_p99_ms", 0.0),
+            "p99_under_chaos_ms": rep.get("p99_during_swap_ms", 0.0),
+            "chaos_window_s": rep.get("swap_window_s", 0.0),
+            "chaos_window_requests": rep.get("swap_window_requests", 0),
+            "shed_rate": round(refusals / max(1, rep["requests"]), 4),
+            "error_amplification": round(
+                snap["upstream_attempt_count"]
+                / max(1, snap["request_count"]), 4),
+            "retry_count": snap["retry_count"],
+            "hedge_count": snap["hedge_count"],
+            "breaker_open_count": snap["breaker_open_count"],
+            "breaker_close_count": snap["breaker_close_count"],
+            "eject_count": snap["eject_count"],
+            "no_replica_count": snap["no_replica_count"],
+            "healthy_replica_count_end": snap["healthy_replica_count"],
+            "deadline_ms": deadline_ms,
+            "qps": qps,
+        })
+        if not os.environ.get("BENCH_NO_HISTORY"):
+            try:
+                from lightgbm_tpu.telemetry import history
+                history.append_run_summary(
+                    os.environ.get("BENCH_HISTORY_PATH", os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "RUN_HISTORY.jsonl")),
+                    "bench_router", rows=rows_per_req,
+                    platform="cpu",
+                    serving_p99_ms=out["steady_p99_ms"],
+                    router_p99_under_chaos_ms=out["p99_under_chaos_ms"],
+                    router_error_amplification=out["error_amplification"],
+                    router_shed_rate=out["shed_rate"])
+            except Exception as e:   # never cost the measurement
+                _mark(f"run-history append failed: {e}")
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"router probe failed: {e}")
+        out["error"] = str(e)[-250:]
+    finally:
+        if rsrv is not None:
+            rsrv.shutdown()
+            rsrv.router.stop()
+            rsrv.server_close()
+        for srv in replicas:   # idempotent for the already-killed one
+            try:
+                srv.shutdown()
+                srv.server_close()
+                srv.batcher.close()
+            except Exception:
+                pass
+    return out
+
+
 def run_ooc_child():
     """Out-of-core probe child (one per mode, so `ru_maxrss` is a clean
     per-mode peak): open the block store the parent built and train the
@@ -1742,6 +1884,10 @@ def main():
     if "fleet_probe" in sys.argv:
         # standalone hot-swap/serving probe: `python bench.py fleet_probe`
         print(json.dumps({"serving": fleet_probe()}), flush=True)
+        return
+    if "router_probe" in sys.argv:
+        # standalone front-door chaos probe: `python bench.py router_probe`
+        print(json.dumps({"router": router_probe()}), flush=True)
         return
     if "--child" in sys.argv:
         run_child()

@@ -305,6 +305,30 @@ class Config:
     # single-host gang is scrapable (application.py)
     aggregate_port: int = 0
 
+    # --- serving resilience (serving/admission.py, fleet/router.py;
+    # no reference equivalent — the reference's only resilience is the
+    # socket linker's connect-retry loop) ---
+    # deadline budget assumed for predict requests that carry no
+    # X-Deadline-Ms header (`--deadline-default-ms` serve flag);
+    # 0 = requests without a header are never deadline-shed
+    deadline_default_ms: float = 0.0
+    # admission control: shed (429 + Retry-After) when estimated queue
+    # wait exceeds this fraction of the request's deadline budget;
+    # brownout (drift/skew/shadow sampling off) engages at half of it
+    # (`--shed-queue-budget` serve flag)
+    shed_queue_budget: float = 1.0
+    # router circuit breaker: consecutive upstream failures that open a
+    # replica's breaker (`fleet route --breaker-failures`)
+    breaker_failures: int = 5
+    # router hedging: send a second copy of a slow predict to another
+    # replica once its latency passes this ring quantile (e.g. 0.99);
+    # 0 = hedging off (`fleet route --hedge-quantile`)
+    hedge_quantile: float = 0.0
+    # router retries: extra upstream attempts allowed per client
+    # request, as a fraction (0.1 = 10% retry budget bounds error
+    # amplification at 1.1x; `fleet route --retry-budget`)
+    retry_budget: float = 0.1
+
     # --- model-quality observability (telemetry/quality.py,
     # io/profile.py, serving/drift.py; no reference equivalent beyond
     # the feature_importance C API) ---
@@ -576,6 +600,16 @@ class Config:
               "roofline_warn_fraction in [0, 1]")
         check(self.slow_request_ms >= 0,
               "slow_request_ms should be >= 0")
+        check(self.deadline_default_ms >= 0,
+              "deadline_default_ms should be >= 0")
+        check(self.shed_queue_budget > 0,
+              "shed_queue_budget should be > 0")
+        check(self.breaker_failures >= 1,
+              "breaker_failures should be >= 1")
+        check(0.0 <= self.hedge_quantile < 1.0,
+              "hedge_quantile in [0, 1)")
+        check(self.retry_budget >= 0,
+              "retry_budget should be >= 0")
         check(0.0 <= self.drift_sample_rate <= 1.0,
               "drift_sample_rate in [0, 1]")
         check(0.0 <= self.skew_sample_rate <= 1.0,

@@ -21,6 +21,16 @@ retrains on the fresh CSV (label in column 0), validates against the
 incumbent on the holdout CSV, and promotes or quarantines through the
 registry — a serving process started with `--registry DIR --follow`
 hot-swaps to the promotion on its next poll.
+
+The front-door router (fleet/router.py, docs/Resilience.md):
+
+    python -m lightgbm_tpu.fleet route \
+        --targets 127.0.0.1:8099,127.0.0.1:8100 [--port 8800] \
+        [--breaker-failures N] [--retry-budget X] [--hedge-quantile Q]
+
+One endpoint over N serving replicas: least-in-flight dispatch,
+per-replica circuit breakers, strict-health ejection, budgeted
+retries and optional hedging.
 """
 
 import argparse
@@ -94,6 +104,33 @@ def main(argv=None):
     p = common(sub.add_parser("verify", help="re-checksum versions"))
     p.add_argument("--version", type=int, default=None)
 
+    p = sub.add_parser(
+        "route", help="front-door router over serving replicas "
+                      "(fleet/router.py, docs/Resilience.md)")
+    p.add_argument("--targets", required=True,
+                   help="comma-separated replica host:port list")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8800)
+    p.add_argument("--breaker-failures", type=int, default=5,
+                   help="consecutive upstream failures that open a "
+                        "replica's circuit breaker (mirrors the "
+                        "breaker_failures config knob)")
+    p.add_argument("--breaker-reset-s", type=float, default=1.0,
+                   help="how long an open breaker waits before its "
+                        "half-open probe")
+    p.add_argument("--retry-budget", type=float, default=0.1,
+                   help="retry tokens granted per client request; caps "
+                        "error amplification at 1 + budget (mirrors "
+                        "retry_budget)")
+    p.add_argument("--hedge-quantile", type=float, default=0.0,
+                   help="duplicate a request still unanswered after "
+                        "this latency quantile (e.g. 0.99); 0 = off "
+                        "(mirrors hedge_quantile)")
+    p.add_argument("--upstream-timeout-s", type=float, default=10.0,
+                   help="hard cap on any single upstream call")
+    p.add_argument("--health-poll-s", type=float, default=0.5,
+                   help="strict /healthz probe interval")
+
     p = common(sub.add_parser(
         "watch", help="drift -> retrain -> validate -> promote loop"))
     p.add_argument("--serving-url", required=True,
@@ -126,6 +163,11 @@ def main(argv=None):
                    help="PR-5 run journal directory for transition "
                         "records")
     args = ap.parse_args(argv)
+
+    if args.cmd == "route":
+        # registry-free: the router only needs replica addresses
+        from .router import main as route_main
+        return route_main(args)
 
     registry = ModelRegistry(args.registry)
     try:

@@ -36,7 +36,8 @@ class LoadGenerator:
     checkable against per-model expectations."""
 
     def __init__(self, url, row_batches, qps=100.0, workers=4,
-                 duration_s=5.0, timeout_s=30.0, path="/predict"):
+                 duration_s=5.0, timeout_s=30.0, path="/predict",
+                 deadline_ms=None):
         self.url = url.rstrip("/") + path
         self.bodies = [json.dumps({"rows": np.asarray(b).tolist()})
                        .encode() for b in row_batches]
@@ -44,9 +45,14 @@ class LoadGenerator:
         self.workers = int(workers)
         self.duration_s = float(duration_s)
         self.timeout_s = float(timeout_s)
+        # deadline propagation (docs/Resilience.md): every request
+        # carries `X-Deadline-Ms: deadline_ms` so the serving side can
+        # deadline-drop/shed; None = header omitted (legacy behavior)
+        self.deadline_ms = deadline_ms
         self.samples = []      # (t_start_rel, latency_s, ok)
         self.responses = []    # (t_start_rel, predictions) when kept
         self.errors = []       # repr strings, bounded
+        self.status_counts = {}   # HTTP status -> count (0 = transport)
         self.keep_responses = False
         self._lock = threading.Lock()
         self._marks = {}       # name -> (t0_rel, t1_rel)
@@ -73,24 +79,33 @@ class LoadGenerator:
                 time.sleep(delay)
             body = self.bodies[i % len(self.bodies)]
             t_req = time.monotonic()
-            ok, preds = True, None
+            ok, preds, status = True, None, 200
+            headers = {"Content-Type": "application/json"}
+            if self.deadline_ms is not None:
+                headers["X-Deadline-Ms"] = str(float(self.deadline_ms))
             try:
                 req = urllib.request.Request(
-                    self.url, data=body,
-                    headers={"Content-Type": "application/json"})
+                    self.url, data=body, headers=headers)
                 with urllib.request.urlopen(
                         req, timeout=self.timeout_s) as r:
+                    status = r.status
                     out = json.loads(r.read())
                 if self.keep_responses:
                     preds = out.get("predictions")
             except Exception as e:   # count, never raise (module doc)
                 ok = False
+                # keep the real status: "zero 5xx under chaos" must
+                # distinguish a refusal (429/504, correct) from a
+                # server error (5xx, a bug); 0 = transport-level error
+                status = getattr(e, "code", 0) or 0
                 with self._lock:
                     if len(self.errors) < 50:
                         self.errors.append(repr(e))
             lat = time.monotonic() - t_req
             with self._lock:
                 self.samples.append((t_req - self.t0, lat, ok))
+                self.status_counts[status] = \
+                    self.status_counts.get(status, 0) + 1
                 if preds is not None:
                     self.responses.append((t_req - self.t0, preds))
             i += self.workers
@@ -132,9 +147,14 @@ class LoadGenerator:
         with self._lock:
             samples = list(self.samples)
             mark = self._marks.get(swap_mark)
+            status_counts = dict(self.status_counts)
         lat_all = [lt for _, lt, ok in samples if ok]
         out = {"requests": len(samples),
                "errors": sum(1 for _, _, ok in samples if not ok),
+               "status_counts": status_counts,
+               "server_errors_5xx": sum(
+                   n for s, n in status_counts.items()
+                   if 500 <= s < 600),
                "offered_qps": round(self.qps, 1)}
         if samples:
             span = max(t for t, _, _ in samples) - min(

@@ -41,6 +41,7 @@ import shutil
 import time
 
 from ..data.mmap_io import crc32_file
+from ..utils import faults
 from ..utils.checkpoint import _fsync_dir, atomic_write_text
 from ..utils.log import Log
 
@@ -198,6 +199,12 @@ class ModelRegistry:
         """Re-checksum every manifested file of a version; raises
         RegistryError on any mismatch (bit rot, truncation, tamper).
         Returns the parsed manifest."""
+        if faults.consume("corrupt_registry_version"):
+            # chaos: a torn publish — verify must fail exactly as if a
+            # checksum mismatched, so followers refuse the swap and the
+            # incumbent keeps serving (tests/test_resilience.py)
+            raise RegistryError(
+                f"v{version}: injected fault corrupt_registry_version")
         vdir = self.version_dir(version)
         man_path = os.path.join(vdir, MANIFEST_NAME)
         try:

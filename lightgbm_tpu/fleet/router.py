@@ -1,0 +1,596 @@
+"""Front-door router: least-in-flight dispatch, circuit breakers,
+health ejection, budgeted retries and hedging over serving replicas.
+
+No reference equivalent — the reference's resilience story ends at the
+socket linker's connect-retry loop (network/linkers_socket.cpp); a
+serving FLEET needs its failures contained at the front door. This is
+a stdlib-only reverse proxy (ThreadingHTTPServer + http.client, the
+same no-new-deps rule as the rest of the serving stack) that makes a
+set of `python -m lightgbm_tpu.serve` replicas look like one endpoint:
+
+    python -m lightgbm_tpu.fleet route \
+        --targets 127.0.0.1:8099,127.0.0.1:8100 --port 8800
+
+Per predict POST (docs/Resilience.md):
+
+- selection: the healthy replica with the fewest router-side in-flight
+  requests (least-in-flight beats round-robin under heterogeneous
+  replica speed — a slowed replica naturally accumulates in-flight and
+  stops being picked).
+- circuit breaker, per replica: `breaker_failures` CONSECUTIVE
+  transport errors / 5xx open the breaker; an open breaker sits out
+  `breaker_reset_s`, then admits exactly ONE half-open probe — success
+  closes it, failure re-opens. 4xx, 429 and 504 are the replica
+  WORKING (refusing correctly), so they never trip it.
+- health ejection: a background thread polls `GET /healthz?strict=1`
+  under a hard timeout; non-200 (including a DRAINING replica — the
+  strict probe exists for exactly that) ejects the replica from
+  selection until it recovers.
+- retries: a transport error or retryable 5xx is retried against a
+  DIFFERENT replica, with seeded jitter, while the retry token bucket
+  (refilled `retry_budget` per client request) has a token — the
+  budget caps error amplification at 1 + retry_budget no matter how
+  hard the fleet is failing. 429/504 propagate to the client
+  unretried: shedding and deadline semantics are end-to-end.
+- hedging (off by default): when `hedge_quantile` > 0 and the latency
+  ring has enough samples, a request still unanswered after that
+  latency quantile fires one duplicate at a second replica; first
+  answer wins and the loser's connection is torn down
+  (`hedge_cancelled_count`). Hedges draw from the same retry budget.
+- deadlines: the client's `X-Deadline-Ms` is re-derived per attempt
+  (remaining = deadline - elapsed) so a retry never inherits a stale
+  budget; every upstream call runs under
+  min(remaining, `upstream_timeout_s`) — no outbound socket is ever
+  unbounded (enforced repo-wide by the `unbounded-io` lint rule).
+
+`/metricz` serves the router's own counters (shed/retry/hedge/eject/
+breaker transitions, per-replica gauges) as JSON (with a
+``"router": true`` marker the fleet aggregator keys on) and canonical
+Prometheus text via `?format=prometheus`; `/healthz` reports the
+replica table. Both are answered locally — admin traffic never
+consumes replica capacity.
+"""
+
+import argparse
+import http.client
+import json
+import queue
+import random
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from ..telemetry import prometheus
+from ..telemetry.registry import MetricsRegistry
+from ..utils.log import Log
+
+# breaker states (ints on the metrics page: closed=0 open=1 half=2)
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+_BREAKER_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+# upstream statuses worth a retry elsewhere: the replica (or its box)
+# is broken. 429/504 are the protocol WORKING — never retried.
+RETRYABLE_STATUSES = (500, 502, 503)
+
+# hedging needs a latency distribution to aim at; below this many
+# samples the quantile is noise and hedging stays off
+MIN_HEDGE_SAMPLES = 20
+
+# token-bucket cap: bursts of retries allowed around a failure spike
+RETRY_BURST_CAP = 10.0
+
+
+class Replica:
+    """Router-side state for one upstream target. All mutable fields
+    are guarded by the owning Router's lock."""
+
+    def __init__(self, target):
+        base = target.split("//")[-1].rstrip("/")
+        host, _, port = base.partition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port or 80)
+        self.target = f"{self.host}:{self.port}"
+        self.in_flight = 0
+        self.breaker = CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+        self.probe_in_flight = False
+        self.ejected = False
+
+    def __repr__(self):
+        return (f"Replica({self.target} {self.breaker}"
+                f"{' ejected' if self.ejected else ''})")
+
+
+class Router:
+    """Replica table + breaker/budget/hedge policy. Pure logic plus
+    http.client calls — the HTTP front end (RouterHandler) and the
+    chaos tests drive the same object."""
+
+    def __init__(self, targets, breaker_failures=5, breaker_reset_s=1.0,
+                 retry_budget=0.1, hedge_quantile=0.0,
+                 upstream_timeout_s=10.0, health_poll_s=0.5,
+                 retry_jitter_ms=5.0):
+        if not targets:
+            raise ValueError("router needs at least one target")
+        self.replicas = [Replica(t) for t in targets]
+        self.breaker_failures = max(1, int(breaker_failures))
+        self.breaker_reset_s = float(breaker_reset_s)
+        self.retry_budget = float(retry_budget)
+        self.hedge_quantile = float(hedge_quantile)
+        self.upstream_timeout_s = float(upstream_timeout_s)
+        self.health_poll_s = float(health_poll_s)
+        self.retry_jitter_ms = float(retry_jitter_ms)
+        self._lock = threading.Lock()
+        # SEEDED jitter: retry spacing must not depend on process
+        # entropy (chaos runs are reproducible; nondeterminism lint)
+        self._rng = random.Random(0x5EED)
+        self._retry_tokens = 1.0   # one free retry before any refill
+        self.registry = MetricsRegistry()
+        reg = self.registry
+        self._requests = reg.counter("request_count")
+        self._attempts = reg.counter("upstream_attempt_count")
+        self._retries = reg.counter("retry_count")
+        self._hedges = reg.counter("hedge_count")
+        self._hedge_cancelled = reg.counter("hedge_cancelled_count")
+        self._no_replica = reg.counter("no_replica_count")
+        self._breaker_opens = reg.counter("breaker_open_count")
+        self._breaker_closes = reg.counter("breaker_close_count")
+        self._ejects = reg.counter("eject_count")
+        self._errors = reg.counter("error_count")
+        self._deadline_expired = reg.counter("deadline_expired_count")
+        self._latency = reg.histogram("latency_ms")
+        self.started_at = time.time()
+        self._stop = threading.Event()
+        self._health_thread = None
+
+    # ------------------------------------------------------------ selection
+    def _breaker_admits(self, rep, now):
+        """Lock held. OPEN->HALF_OPEN transition happens lazily here:
+        the first pick after the reset window becomes the probe."""
+        if rep.breaker == CLOSED:
+            return True
+        if rep.breaker == OPEN:
+            if now - rep.opened_at >= self.breaker_reset_s:
+                rep.breaker = HALF_OPEN
+                rep.probe_in_flight = False
+                return not rep.probe_in_flight
+            return False
+        return not rep.probe_in_flight   # HALF_OPEN: one probe at a time
+
+    def pick(self, exclude=()):
+        """Least-in-flight healthy replica, or None. A HALF_OPEN pick
+        claims the single probe slot."""
+        now = time.monotonic()
+        with self._lock:
+            best = None
+            for rep in self.replicas:
+                if rep in exclude or rep.ejected:
+                    continue
+                if not self._breaker_admits(rep, now):
+                    continue
+                if best is None or rep.in_flight < best.in_flight:
+                    best = rep
+            if best is not None and best.breaker == HALF_OPEN:
+                best.probe_in_flight = True
+            return best
+
+    # -------------------------------------------------------------- breaker
+    def on_success(self, rep):
+        with self._lock:
+            rep.consecutive_failures = 0
+            rep.probe_in_flight = False
+            if rep.breaker != CLOSED:
+                rep.breaker = CLOSED
+                self._breaker_closes.inc()
+                Log.info("router: breaker CLOSED for %s", rep.target)
+
+    def on_failure(self, rep):
+        now = time.monotonic()
+        with self._lock:
+            rep.consecutive_failures += 1
+            if rep.breaker == HALF_OPEN:
+                # the probe failed: straight back to OPEN
+                rep.breaker = OPEN
+                rep.opened_at = now
+                rep.probe_in_flight = False
+                self._breaker_opens.inc()
+                Log.info("router: breaker RE-OPENED for %s", rep.target)
+            elif (rep.breaker == CLOSED
+                  and rep.consecutive_failures >= self.breaker_failures):
+                rep.breaker = OPEN
+                rep.opened_at = now
+                self._breaker_opens.inc()
+                Log.warning("router: breaker OPEN for %s (%d consecutive "
+                            "failures)", rep.target,
+                            rep.consecutive_failures)
+
+    # -------------------------------------------------------------- budget
+    def _grant_request_budget(self):
+        with self._lock:
+            self._retry_tokens = min(RETRY_BURST_CAP,
+                                     self._retry_tokens + self.retry_budget)
+
+    def _take_retry_token(self):
+        with self._lock:
+            if self._retry_tokens >= 1.0:
+                self._retry_tokens -= 1.0
+                return True
+            return False
+
+    # -------------------------------------------------------------- health
+    def probe_health(self):
+        """One health sweep over every replica (the poll thread's body;
+        tests call it directly for a deterministic step)."""
+        timeout = max(0.1, min(1.0, self.health_poll_s))
+        for rep in self.replicas:
+            healthy = False
+            conn = http.client.HTTPConnection(rep.host, rep.port,
+                                              timeout=timeout)
+            try:
+                conn.request("GET", "/healthz?strict=1")
+                healthy = conn.getresponse().status == 200
+            except OSError:
+                healthy = False
+            finally:
+                conn.close()
+            with self._lock:
+                if rep.ejected != (not healthy):
+                    if healthy:
+                        Log.info("router: %s back in rotation",
+                                 rep.target)
+                    else:
+                        self._ejects.inc()
+                        Log.warning("router: ejected %s (strict health "
+                                    "probe failed)", rep.target)
+                rep.ejected = not healthy
+
+    def start_health_loop(self):
+        def loop():
+            while not self._stop.wait(self.health_poll_s):
+                self.probe_health()
+        self._health_thread = threading.Thread(
+            target=loop, name="router-health", daemon=True)
+        self._health_thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=2.0)
+
+    # ------------------------------------------------------------- proxying
+    def _proxy_once(self, rep, path, body, headers, timeout_s,
+                    conn_box=None):
+        """One upstream attempt. Returns (status, resp_headers, data);
+        raises OSError-family on transport failure. `conn_box` lets a
+        hedging race close this connection from outside (cancel)."""
+        self._attempts.inc()
+        conn = http.client.HTTPConnection(rep.host, rep.port,
+                                          timeout=timeout_s)
+        if conn_box is not None:
+            conn_box.append(conn)
+        with self._lock:
+            rep.in_flight += 1
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            keep = {k: v for k, v in resp.getheaders()
+                    if k.lower() in ("content-type", "retry-after",
+                                     "x-request-id", "x-timing-ms")}
+            return resp.status, keep, data
+        finally:
+            with self._lock:
+                rep.in_flight -= 1
+            conn.close()
+
+    def _attempt_timeout(self, deadline_abs):
+        if deadline_abs is None:
+            return self.upstream_timeout_s
+        remaining = deadline_abs - time.monotonic()
+        return max(0.05, min(self.upstream_timeout_s, remaining))
+
+    def _upstream_headers(self, headers, deadline_abs):
+        out = dict(headers)
+        if deadline_abs is not None:
+            # re-derive the remaining budget per attempt: a retry must
+            # not inherit the original (now stale) header value
+            remaining_ms = max(0.0,
+                               (deadline_abs - time.monotonic()) * 1e3)
+            out["X-Deadline-Ms"] = f"{remaining_ms:.1f}"
+        return out
+
+    def _hedge_delay_s(self):
+        if self.hedge_quantile <= 0.0:
+            return None
+        if self._latency.window < MIN_HEDGE_SAMPLES:
+            return None
+        pct = self.hedge_quantile * 100.0
+        ms = self._latency.percentiles((pct,)).get(pct)
+        return None if ms is None else ms / 1e3
+
+    def _attempt(self, rep, path, body, headers, deadline_abs):
+        """One attempt with optional hedging. Returns
+        (status, headers, data, error, rep_that_answered)."""
+        timeout_s = self._attempt_timeout(deadline_abs)
+        up_headers = self._upstream_headers(headers, deadline_abs)
+        hedge_delay = self._hedge_delay_s()
+        if hedge_delay is None:
+            try:
+                status, rh, data = self._proxy_once(
+                    rep, path, body, up_headers, timeout_s)
+                return status, rh, data, None, rep
+            except OSError as e:
+                return None, {}, b"", e, rep
+
+        results = queue.Queue()
+        races = []    # [(replica, [conns])]
+
+        def run(target_rep):
+            box = []
+            races.append((target_rep, box))
+            try:
+                results.put((target_rep,)
+                            + self._proxy_once(target_rep, path, body,
+                                               self._upstream_headers(
+                                                   headers, deadline_abs),
+                                               timeout_s, conn_box=box)
+                            + (None,))
+            except OSError as e:
+                results.put((target_rep, None, {}, b"", e))
+
+        threading.Thread(target=run, args=(rep,), daemon=True).start()
+        launched = 1
+        try:
+            # primary answered (or failed fast) inside the hedge delay:
+            # no hedge — a fast FAILURE is dispatch()'s budgeted-retry
+            # business, hedging only covers slowness
+            won, status, rh, data, err = results.get(timeout=hedge_delay)
+            return status, rh, data, err, won
+        except queue.Empty:
+            pass
+        second = self.pick(exclude=(rep,))
+        if second is not None and self._take_retry_token():
+            self._hedges.inc()
+            threading.Thread(target=run, args=(second,),
+                             daemon=True).start()
+            launched = 2
+        best = None
+        for _ in range(launched):
+            try:
+                out = results.get(timeout=timeout_s + 1.0)
+            except queue.Empty:
+                break
+            won, status, rh, data, err = out
+            if err is None and status not in RETRYABLE_STATUSES:
+                # first good answer wins: abort the loser's socket so
+                # no orphan result is ever written to the client
+                for racer_rep, box in races:
+                    if racer_rep is not won:
+                        for c in box:
+                            try:
+                                c.close()
+                            except OSError:
+                                pass
+                        self._hedge_cancelled.inc()
+                return status, rh, data, None, won
+            best = out
+        if best is None:
+            return None, {}, b"", OSError("hedge race produced no "
+                                          "answer"), rep
+        won, status, rh, data, err = best
+        return status, rh, data, err, won
+
+    def dispatch(self, path, body, headers):
+        """Route one client predict: pick -> attempt -> (budgeted)
+        retries. Returns (status, headers, data)."""
+        t0 = time.monotonic()
+        self._requests.inc()
+        self._grant_request_budget()
+        deadline_abs = None
+        dl = headers.get("X-Deadline-Ms")
+        if dl is not None:
+            try:
+                deadline_abs = t0 + float(dl) / 1e3
+            except ValueError:
+                deadline_abs = None
+        tried = set()
+        last = (502, {}, json.dumps(
+            {"error": "no upstream attempt"}).encode())
+        while True:
+            if deadline_abs is not None \
+                    and deadline_abs <= time.monotonic():
+                self._deadline_expired.inc()
+                return 504, {}, json.dumps(
+                    {"error": "deadline expired at router"}).encode()
+            rep = self.pick(exclude=tried)
+            if rep is None:
+                if not tried:
+                    self._no_replica.inc()
+                    self._errors.inc()
+                    return 503, {"Retry-After": "1"}, json.dumps(
+                        {"error": "no healthy replica"}).encode()
+                self._errors.inc()
+                return last
+            status, rh, data, err, won = self._attempt(
+                rep, path, body, headers, deadline_abs)
+            # the answering replica's breaker gets the credit/blame —
+            # when a hedge won, the slow primary is not a "failure"
+            failed = err is not None or status in RETRYABLE_STATUSES
+            (self.on_failure if failed else self.on_success)(won)
+            if not failed:
+                self._latency.observe((time.monotonic() - t0) * 1e3)
+                return status, rh, data
+            last = (status if status is not None else 502,
+                    rh, data or json.dumps(
+                        {"error": f"upstream failed: {err}"}).encode())
+            tried.add(rep)
+            if not self._take_retry_token():
+                self._errors.inc()
+                return last
+            self._retries.inc()
+            # seeded jitter de-synchronizes retry stampedes
+            time.sleep(self._rng.uniform(0.0, self.retry_jitter_ms) / 1e3)
+
+    # -------------------------------------------------------------- metrics
+    def snapshot(self):
+        """JSON /metricz payload. The ``"router": true`` marker is what
+        the fleet aggregator keys the router role on."""
+        with self.registry.lock:
+            pct = self._latency.percentiles((50, 95, 99))
+            snap = {
+                "router": True,
+                "uptime_s": round(time.time() - self.started_at, 3),
+                "request_count": self._requests.value,
+                "upstream_attempt_count": self._attempts.value,
+                "retry_count": self._retries.value,
+                "hedge_count": self._hedges.value,
+                "hedge_cancelled_count": self._hedge_cancelled.value,
+                "no_replica_count": self._no_replica.value,
+                "breaker_open_count": self._breaker_opens.value,
+                "breaker_close_count": self._breaker_closes.value,
+                "eject_count": self._ejects.value,
+                "error_count": self._errors.value,
+                "deadline_expired_count": self._deadline_expired.value,
+                "latency_p50_ms": round(pct.get(50, 0.0), 4),
+                "latency_p95_ms": round(pct.get(95, 0.0), 4),
+                "latency_p99_ms": round(pct.get(99, 0.0), 4),
+                "latency_window": self._latency.window,
+            }
+        with self._lock:
+            snap["replica_count"] = len(self.replicas)
+            snap["healthy_replica_count"] = sum(
+                1 for r in self.replicas
+                if not r.ejected and r.breaker == CLOSED)
+            snap["replicas"] = [
+                {"target": r.target, "in_flight": r.in_flight,
+                 "breaker": r.breaker, "ejected": r.ejected,
+                 "consecutive_failures": r.consecutive_failures}
+                for r in self.replicas]
+        return snap
+
+    def prometheus(self):
+        snap = self.snapshot()
+        extra = {k: v for k, v in snap.items()
+                 if isinstance(v, (int, float))
+                 and not isinstance(v, bool)}
+        with self._lock:
+            for i, rep in enumerate(self.replicas):
+                extra[f"replica_{i}_in_flight"] = rep.in_flight
+                extra[f"replica_{i}_breaker_state"] = \
+                    _BREAKER_CODE[rep.breaker]
+                extra[f"replica_{i}_ejected"] = int(rep.ejected)
+        return prometheus.render(self.registry.snapshot(),
+                                 extra_gauges=extra)
+
+
+class RouterHandler(BaseHTTPRequestHandler):
+    """Thin HTTP front end over the shared Router object."""
+
+    protocol_version = "HTTP/1.1"
+    router = None    # bound by make_router_server
+
+    def log_message(self, fmt, *args):
+        Log.debug("router http: " + fmt, *args)
+
+    def _reply(self, code, data, headers=None):
+        if isinstance(data, (dict, list)):
+            data = json.dumps(data).encode("utf-8")
+        self.send_response(code)
+        hdrs = dict(headers or {})
+        hdrs.setdefault("Content-Type", "application/json")
+        hdrs["Content-Length"] = str(len(data))
+        for name, value in hdrs.items():
+            self.send_header(name, value)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        parts = urlsplit(self.path)
+        fmt = (parse_qs(parts.query).get("format") or [""])[0]
+        if parts.path.startswith("/healthz"):
+            snap = self.router.snapshot()
+            healthy = snap["healthy_replica_count"] > 0
+            self._reply(200 if healthy else 503,
+                        {"status": "ok" if healthy else "no_replicas",
+                         "router": True,
+                         "replicas": snap["replicas"]})
+        elif parts.path.startswith("/metricz"):
+            if fmt == "prometheus":
+                data = self.router.prometheus().encode("utf-8")
+                self._reply(200, data,
+                            {"Content-Type": prometheus.CONTENT_TYPE})
+            else:
+                self._reply(200, self.router.snapshot())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        path = self.path.split("?")[0]
+        if path not in ("/predict", "/predict_raw", "/predict_leaf"):
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        if "chunked" in (self.headers.get("Transfer-Encoding")
+                         or "").lower():
+            self.close_connection = True
+            self._reply(411, {"error": "chunked bodies not supported"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            self.close_connection = True
+            self._reply(400, {"error": "malformed Content-Length"})
+            return
+        body = self.rfile.read(length) if length > 0 else b""
+        fwd = {k: v for k, v in self.headers.items()
+               if k.lower() in ("content-type", "x-request-id",
+                                "x-deadline-ms")}
+        fwd["Content-Length"] = str(len(body))
+        status, rh, data = self.router.dispatch(path, body, fwd)
+        self._reply(status, data, rh)
+
+
+class RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+
+def make_router_server(targets, host="127.0.0.1", port=8800, **knobs):
+    """Router + bound handler + ThreadingHTTPServer (not yet serving).
+    `knobs` are Router() kwargs. Starts the health loop; the caller
+    owns serve_forever and shutdown (srv.router.stop() on teardown)."""
+    router = Router(targets, **knobs)
+    handler = type("BoundRouterHandler", (RouterHandler,),
+                   {"router": router})
+    srv = RouterHTTPServer((host, port), handler)
+    srv.router = router
+    router.probe_health()      # populate ejection state before traffic
+    router.start_health_loop()
+    return srv
+
+
+def main(args):
+    """`python -m lightgbm_tpu.fleet route` entry (fleet/__main__.py
+    parses the arguments and calls this)."""
+    targets = [t for t in (args.targets or "").split(",") if t.strip()]
+    srv = make_router_server(
+        targets, host=args.host, port=args.port,
+        breaker_failures=args.breaker_failures,
+        breaker_reset_s=args.breaker_reset_s,
+        retry_budget=args.retry_budget,
+        hedge_quantile=args.hedge_quantile,
+        upstream_timeout_s=args.upstream_timeout_s,
+        health_poll_s=args.health_poll_s)
+    Log.info("router fronting %d replica(s): %s", len(targets),
+             ", ".join(targets))
+    # the driver-facing readiness line (same contract as SERVING)
+    print(f"ROUTER http://{args.host}:{srv.server_address[1]}",
+          flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.router.stop()
+        srv.server_close()
+    return 0

@@ -21,7 +21,18 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..utils import faults
+
 KINDS = ("predict", "raw", "leaf")
+
+# EWMA weight of the newest dispatch in the service-time estimate the
+# admission controller reads (serving/admission.py): ~last 10 batches
+EWMA_ALPHA = 0.2
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request's deadline expired before its batch dispatched; the
+    HTTP layer maps this to 504 (no device time was spent on it)."""
 
 
 class MicroBatcher:
@@ -37,9 +48,14 @@ class MicroBatcher:
                                              4096))
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.metrics = metrics
+        # per-server chaos overrides (utils/faults.serving_chaos); the
+        # serving server shares its dict here so `wedge_batcher` can
+        # target one in-process replica
+        self.chaos = None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._queue = []          # [(kind, rows, future, t_enqueue)]
+        self._queue = []    # [(kind, rows, future, t_enqueue, deadline)]
+        self._est_service_s = 0.0   # EWMA batch service time (0=unknown)
         self._closed = False
         self._busy = False        # worker is mid-dispatch (quiesce check)
         self._worker = threading.Thread(target=self._run,
@@ -47,9 +63,13 @@ class MicroBatcher:
         self._worker.start()
 
     # ---------------------------------------------------------------- client
-    def submit(self, rows, kind="predict"):
+    def submit(self, rows, kind="predict", deadline=None):
         """Enqueue one request; returns a concurrent.futures.Future
-        resolving to that request's own result rows."""
+        resolving to that request's own result rows. `deadline` is an
+        ABSOLUTE time.monotonic() instant: a request still queued past
+        it fails with DeadlineExceeded before any device time is spent
+        on it (the worker drops expired entries as it assembles each
+        batch)."""
         if kind not in KINDS:
             raise ValueError(f"kind must be one of {KINDS}, got {kind!r}")
         rows = np.atleast_2d(np.asarray(rows, dtype=np.float32))
@@ -68,7 +88,7 @@ class MicroBatcher:
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
-            self._queue.append((kind, rows, fut, fut.t_enqueue))
+            self._queue.append((kind, rows, fut, fut.t_enqueue, deadline))
             self._cond.notify()
         return fut
 
@@ -80,6 +100,13 @@ class MicroBatcher:
     def queue_depth(self):
         with self._lock:
             return len(self._queue)
+
+    def estimated_service_s(self):
+        """EWMA of recent batch service (dispatch->done) seconds; 0.0
+        until the first dispatch completes. The admission controller
+        multiplies this by the queue backlog to estimate wait
+        (serving/admission.py)."""
+        return self._est_service_s
 
     def quiescent(self):
         """True when nothing is queued AND the worker is not
@@ -111,33 +138,55 @@ class MicroBatcher:
         max_batch_rows). Returns (kind, [(rows, future)]) or None when
         closed and drained. Runs with the lock held via _cond."""
         with self._cond:
-            while not self._queue and not self._closed:
-                self._cond.wait()
+            # chaos: `wedge_batcher` parks the worker in this wait loop
+            # even when work is queued (queue grows, admission control
+            # must shed); clearing the fault un-wedges without a
+            # restart, and close() still drains what queued up
+            while not self._closed and (
+                    not self._queue
+                    or faults.serving_chaos(self.chaos).get(
+                        "wedge_batcher")):
+                self._cond.wait(timeout=0.05 if self._queue else None)
             if not self._queue:
                 return None  # closed and drained
             # the single worker is the only consumer, so the head (and
             # its arrival time) cannot change while we wait for company
-            deadline = self._queue[0][3] + self.max_wait_s
+            wait_until = self._queue[0][3] + self.max_wait_s
             kind = self._queue[0][0]
             while True:
-                rows_queued = sum(r.shape[0] for k, r, _, _ in self._queue
+                rows_queued = sum(r.shape[0]
+                                  for k, r, _, _, _ in self._queue
                                   if k == kind)
-                remaining = deadline - time.monotonic()
+                remaining = wait_until - time.monotonic()
                 if (rows_queued >= self.max_batch_rows or remaining <= 0
                         or self._closed):
                     break
                 self._cond.wait(timeout=remaining)
-            batch, rest, taken = [], [], 0
+            now = time.monotonic()
+            batch, rest, expired, taken = [], [], [], 0
             for item in self._queue:
-                k, rows, fut, _ = item
-                if k == kind and taken < self.max_batch_rows:
+                k, rows, fut, _, req_deadline = item
+                if req_deadline is not None and now > req_deadline:
+                    # expired while queued: fail it BEFORE dispatch —
+                    # the client already gave up, so device time spent
+                    # on it would be pure waste (504 at the HTTP layer)
+                    expired.append(fut)
+                elif k == kind and taken < self.max_batch_rows:
                     batch.append((rows, fut))
                     taken += rows.shape[0]
                 else:
                     rest.append(item)
             self._queue = rest
             self._busy = True   # cleared by _run after futures resolve
-            return kind, batch
+        for fut in expired:
+            fut.t_dispatch = fut.t_done = time.monotonic()
+            fut.set_exception(DeadlineExceeded(
+                "deadline expired before dispatch"))
+        if not batch:
+            with self._lock:
+                self._busy = False
+            return kind, []
+        return kind, batch
 
     def _run(self):
         while True:
@@ -145,6 +194,8 @@ class MicroBatcher:
             if got is None:
                 return
             kind, batch = got
+            if not batch:
+                continue    # every queued entry had expired
             # ONE predictor snapshot per batch: a concurrent hot-swap
             # (swap_predictor) lands between batches, never inside one —
             # a coalesced dispatch is scored entirely by one model
@@ -183,6 +234,11 @@ class MicroBatcher:
                     self._busy = False
                 continue
             t_done = time.monotonic()
+            dt = t_done - t_dispatch
+            self._est_service_s = (
+                dt if self._est_service_s == 0.0
+                else (1.0 - EWMA_ALPHA) * self._est_service_s
+                + EWMA_ALPHA * dt)
             if self.metrics is not None:
                 self.metrics.record_batch(rows.shape[0], len(batch))
             s = 0

@@ -35,6 +35,12 @@ class ServingMetrics:
         self._batched_rows = self.registry.counter("batched_rows")
         self._batched_requests = self.registry.counter("batched_requests")
         self._errors = self.registry.counter("error_count")
+        # resilience layer (serving/admission.py, docs/Resilience.md):
+        # requests refused before dispatch, split by cause
+        self._shed = self.registry.counter("shed_count")
+        self._deadline_expired = self.registry.counter(
+            "deadline_expired_count")
+        self._brownout = self.registry.gauge("brownout_active")
         self._latency = self.registry.histogram("latency_ms", ring_size)
         self.started_at = time.time()
 
@@ -58,6 +64,19 @@ class ServingMetrics:
 
     def record_error(self):
         self._errors.inc()
+
+    def record_shed(self):
+        """One request refused by admission control (429/503)."""
+        self._shed.inc()
+
+    def record_deadline_expired(self):
+        """One request dropped because its deadline passed (504)."""
+        self._deadline_expired.inc()
+
+    def set_brownout(self, active):
+        """Publish the brownout state (1 = quality monitors disabled
+        to save headroom, 0 = full service)."""
+        self._brownout.set(1 if active else 0)
 
     # ------------------------------------------------------------- readers
     @property
@@ -84,6 +103,14 @@ class ServingMetrics:
     def error_count(self):
         return self._errors.value
 
+    @property
+    def shed_count(self):
+        return self._shed.value
+
+    @property
+    def deadline_expired_count(self):
+        return self._deadline_expired.value
+
     def latency_percentiles(self, pcts=(50, 95, 99)):
         """{p: milliseconds} over the ring's recorded window; empty dict
         before the first request (nearest-rank — see
@@ -104,6 +131,9 @@ class ServingMetrics:
                 "request_count": self.request_count,
                 "rows_served": self.rows_served,
                 "error_count": self.error_count,
+                "shed_count": self.shed_count,
+                "deadline_expired_count": self.deadline_expired_count,
+                "brownout_active": self._brownout.value,
                 "batch_count": batches,
                 "batch_occupancy_rows": round(occ, 3),
                 "batch_occupancy_requests": round(per_batch, 3),

@@ -32,6 +32,17 @@ retryable 503 while in-flight requests finish (bounded by
 --drain-timeout-s); only then does the listener close and the process
 exit.
 
+Resilience (docs/Resilience.md): an `X-Deadline-Ms` header carries the
+client's remaining budget — requests that expire in the queue are
+dropped BEFORE dispatch (504), and the admission controller
+(serving/admission.py) sheds with 429 + Retry-After when the estimated
+queue wait exceeds the budget, browning out the drift/skew monitors
+first. `/healthz?strict=1` goes non-200 while draining so the fleet
+router (fleet/router.py) ejects this replica before the listener
+closes. Chaos faults (utils/faults.py: slow_replica_ms, error_rate,
+drop_connection, wedge_batcher) are injectable per-server for the
+resilience suite.
+
 Request body: JSON `{"rows": [[...], ...]}` (or `{"row": [...]}` for a
 single row), or `text/csv` — one comma/tab-separated row per line.
 Response: JSON `{"predictions": [[...], ...], "rows": N,
@@ -59,8 +70,10 @@ import numpy as np
 
 from ..io.parser import NA_VALUES
 from ..telemetry import prometheus
+from ..utils import faults
 from ..utils.log import Log
-from .batcher import MicroBatcher
+from .admission import AdmissionController
+from .batcher import DeadlineExceeded, MicroBatcher
 from .compiled_model import DEFAULT_MAX_BATCH_ROWS, CompiledPredictor
 from .metrics import ServingMetrics
 
@@ -218,10 +231,20 @@ class ServingHandler(BaseHTTPRequestHandler):
         parts = urlsplit(self.path)
         fmt = (parse_qs(parts.query).get("format") or [""])[0]
         if parts.path.startswith("/healthz"):
-            self._reply(200, {"status": "ok",
-                              "model": self.predictor.describe(),
-                              "model_version": getattr(
-                                  self.server, "model_version", None)})
+            # the router ejects on `?strict=1`: a DRAINING replica is
+            # alive (plain probes stay 200 for process supervisors)
+            # but must stop receiving new traffic before its listener
+            # closes — strict probes go non-200 the moment the drain
+            # flag is set (docs/Resilience.md)
+            draining = bool(getattr(self.server, "draining", False))
+            strict = (parse_qs(parts.query).get("strict") or ["0"])[0]
+            code = 503 if draining and strict not in ("", "0") else 200
+            self._reply(code, {"status": "draining" if draining
+                                         else "ok",
+                               "draining": draining,
+                               "model": self.predictor.describe(),
+                               "model_version": getattr(
+                                   self.server, "model_version", None)})
         elif parts.path.startswith("/quiescez"):
             # admin drain check: a clean flip/restart waits for 200
             srv = self.server
@@ -278,7 +301,10 @@ class ServingHandler(BaseHTTPRequestHandler):
                 self._reply(503, {"error": "draining: server is "
                                            "shutting down",
                                   "request_id": req_id},
-                            {"X-Request-Id": req_id})
+                            {"X-Request-Id": req_id,
+                             # a sibling replica can take this NOW —
+                             # the hint just stops tight retry loops
+                             "Retry-After": "1"})
                 self._access_log(req_id, 0, 503, None)
                 return
             self._handle_post()
@@ -332,10 +358,65 @@ class ServingHandler(BaseHTTPRequestHandler):
             self._access_log(req_id, 0, 400, None)
             return
         t_parsed = time.monotonic()
+        srv = self.server
+        # ---- chaos hooks (utils/faults serving faults; no-ops unless
+        # a fault is armed globally or on this server's overrides dict)
+        chaos = faults.serving_chaos(getattr(srv, "chaos", None))
+        if chaos:
+            slow = chaos.get("slow_replica_ms")
+            if slow:
+                time.sleep(float(slow) / 1e3)
+            if faults.consume_from("drop_connection",
+                                   getattr(srv, "chaos", None)):
+                # torn connection: no response bytes at all — the
+                # router must see a transport error, not a status
+                self.close_connection = True
+                self._access_log(req_id, rows.shape[0], 0, None)
+                return
+            if faults.error_rate_fires(
+                    getattr(srv, "chaos_error_state", {}),
+                    chaos.get("error_rate")):
+                self.metrics.record_error()
+                self._reply(500, {"error": "injected fault: error_rate",
+                                  "request_id": req_id}, id_hdr)
+                self._access_log(req_id, rows.shape[0], 500, None)
+                return
+        # ---- deadline + admission (serving/admission.py): refuse work
+        # we cannot finish in time BEFORE it costs a device dispatch
+        admission = getattr(srv, "admission", None)
+        deadline = None
+        if admission is not None:
+            deadline = admission.deadline_from_header(
+                self.headers.get("X-Deadline-Ms"), now=t_parsed)
+            if deadline is not None and deadline <= time.monotonic():
+                self.metrics.record_deadline_expired()
+                self._reply(504, {"error": "deadline already expired",
+                                  "request_id": req_id}, id_hdr)
+                self._access_log(req_id, rows.shape[0], 504, None)
+                return
+            verdict, retry_after = admission.assess(deadline)
+            if verdict == "shed":
+                headers = dict(id_hdr)
+                headers["Retry-After"] = str(
+                    max(1, int(round(retry_after))))
+                self._reply(429, {"error": "shedding load: queue wait "
+                                           "exceeds deadline budget",
+                                  "retry_after_s": round(retry_after, 3),
+                                  "request_id": req_id}, headers)
+                self._access_log(req_id, rows.shape[0], 429, None)
+                return
         fut = None
         try:
-            fut = self.batcher.submit(rows, kind=kind)
+            fut = self.batcher.submit(rows, kind=kind, deadline=deadline)
             out = fut.result(timeout=60.0)
+        except DeadlineExceeded:
+            # expired while queued: the batcher dropped it before any
+            # device time was spent (504 — the client already moved on)
+            self.metrics.record_deadline_expired()
+            self._reply(504, {"error": "deadline expired in queue",
+                              "request_id": req_id}, id_hdr)
+            self._access_log(req_id, rows.shape[0], 504, None)
+            return
         except Exception as e:  # dispatch fault/timeout: OUR fault — a
             self.metrics.record_error()  # 4xx would read as a caller
             self._reply(500, {"error": str(e),  # error and stop retries
@@ -384,6 +465,12 @@ class ServingHandler(BaseHTTPRequestHandler):
         can drop one sample, a false skew alarm cannot be retracted."""
         owner, drift, skew = self.monitor_state   # ONE atomic read
         if drift is None and skew is None:
+            return
+        admission = getattr(self.server, "admission", None)
+        if admission is not None and admission.brownout_active:
+            # brownout: quality monitoring is the FIRST thing shed
+            # under pressure — monitors drop samples gracefully, predict
+            # traffic does not (docs/Resilience.md)
             return
         scored_by = getattr(fut, "scored_by", None)
         if scored_by is not None and scored_by is not owner:
@@ -513,7 +600,8 @@ def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
                 max_batch_rows=None,
                 slow_request_ms=DEFAULT_SLOW_REQUEST_MS,
                 drift=None, skew=None, model_version=None,
-                monitor_settings=None):
+                monitor_settings=None, deadline_default_ms=0.0,
+                shed_queue_budget=1.0):
     """Wire predictor + batcher + metrics (+ optional drift/skew
     monitors, serving/drift.py) into a ThreadingHTTPServer (not yet
     serving — call serve_forever, or use it from tests).
@@ -535,6 +623,17 @@ def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
     srv.inflight = _InflightGauge()
     srv.draining = False
     srv.monitor_settings = dict(monitor_settings or {})
+    # resilience layer (serving/admission.py, docs/Resilience.md)
+    srv.admission = AdmissionController(
+        batcher, metrics=metrics,
+        deadline_default_ms=deadline_default_ms,
+        shed_queue_budget=shed_queue_budget)
+    # per-server chaos overrides (utils/faults.serving_chaos): the
+    # chaos harness slows/breaks ONE in-process replica through this
+    # dict; the batcher shares it for `wedge_batcher`
+    srv.chaos = {}
+    srv.chaos_error_state = {}
+    batcher.chaos = srv.chaos
     return srv
 
 
@@ -593,6 +692,16 @@ def main(argv=None):
                     help="requests slower than this emit a structured "
                          "slow-request log line (0 = off; mirrors the "
                          "slow_request_ms config knob)")
+    ap.add_argument("--deadline-default-ms", type=float, default=0.0,
+                    help="deadline budget assumed for requests without "
+                         "an X-Deadline-Ms header (0 = such requests "
+                         "are never deadline-shed; mirrors the "
+                         "deadline_default_ms config knob)")
+    ap.add_argument("--shed-queue-budget", type=float, default=1.0,
+                    help="shed (429) when estimated queue wait exceeds "
+                         "this fraction of the deadline budget; "
+                         "brownout engages at half of it (mirrors the "
+                         "shed_queue_budget config knob)")
     ap.add_argument("--num-iteration", type=int, default=-1,
                     help="serve only the first N iterations of the model")
     from .drift import (DEFAULT_DRIFT_SAMPLE_RATE, DEFAULT_PSI_WARN,
@@ -669,7 +778,9 @@ def main(argv=None):
                       slow_request_ms=args.slow_request_ms,
                       drift=drift, skew=skew,
                       model_version=model_version,
-                      monitor_settings=monitor_settings)
+                      monitor_settings=monitor_settings,
+                      deadline_default_ms=args.deadline_default_ms,
+                      shed_queue_budget=args.shed_queue_budget)
     # the swap path re-applies this knob to every challenger
     # (fleet/hotswap.py HotSwapper)
     srv.num_iteration = args.num_iteration

@@ -19,10 +19,14 @@ into a single merged snapshot served as
   unique), fleet-level values as `fleet_*` gauges;
 - `/healthz` — aggregator liveness + per-target reachability.
 
-Targets are `[role=]host:port` specs; `role` is `train`, `serve`, or
-`auto` (default — probe /trainz first, fall back to the serving
-/metricz). A dead target stays in the snapshot with `ok: false` and
-its last error so a vanished rank is a visible fact, not a silent gap.
+Targets are `[role=]host:port` specs; `role` is `train`, `serve`,
+`router`, or `auto` (default — probe /trainz first, fall back to
+/metricz; a front-door router self-identifies via the `"router": true`
+marker in its /metricz, fleet/router.py). A dead target stays in the
+snapshot with `ok: false` and its last error so a vanished rank is a
+visible fact, not a silent gap. Router targets contribute the
+resilience rollup (`router_retry_count`, `router_breaker_open_count`,
+`router_min_healthy_replicas`, ...) to the `fleet` view.
 
 CLI (the ops entry point; `aggregate_port` in docs/Parameters.md):
 
@@ -46,7 +50,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..utils.log import Log
 from . import prometheus
 
-ROLES = ("auto", "train", "serve")
+ROLES = ("auto", "train", "serve", "router")
 
 # flat serving-/metricz fields that are counters in the replica's own
 # registry (serving/metrics.py) — the aggregator must render them with
@@ -55,7 +59,16 @@ ROLES = ("auto", "train", "serve")
 # the replica itself renders as gauges)
 SERVING_COUNTER_FIELDS = frozenset((
     "request_count", "rows_served", "error_count", "batch_count",
-    "batched_rows", "batched_requests"))
+    "batched_rows", "batched_requests", "shed_count",
+    "deadline_expired_count"))
+
+# the front-door router's /metricz counters (fleet/router.py); same
+# render-as-counter rule as the serving fields above
+ROUTER_COUNTER_FIELDS = frozenset((
+    "request_count", "upstream_attempt_count", "retry_count",
+    "hedge_count", "hedge_cancelled_count", "no_replica_count",
+    "breaker_open_count", "breaker_close_count", "eject_count",
+    "error_count", "deadline_expired_count"))
 
 
 class Target:
@@ -124,7 +137,12 @@ class FleetAggregator:
                     if target.role == "train":
                         raise
             data = _get_json(target.url("/metricz"), self.timeout_s)
-            doc.update(ok=True, role="serve", data=data,
+            # the router's /metricz self-identifies (`"router": true`,
+            # fleet/router.py) so `auto` targets resolve without a
+            # dedicated probe path
+            role = ("router" if data.get("router") is True
+                    or target.role == "router" else "serve")
+            doc.update(ok=True, role=role, data=data,
                        label=str(self.targets.index(target)))
             return doc
         except Exception as e:
@@ -184,19 +202,24 @@ class FleetAggregator:
                     extra["comm_overlap_pct"] = ov
                 parts.append((labels, snap, extra))
             else:
-                # serving /metricz is a flat scalar document; its
-                # counter fields must render as COUNTERS so the
-                # aggregator page carries the same canonical names
-                # (lightgbm_tpu_request_total, ...) as the replica's
-                # own exposition — a dashboard built against one page
-                # must match the other
-                labels = {"replica": doc.get("label", "?"),
-                          "role": "serve"}
+                # serving and router /metricz are flat scalar
+                # documents; their counter fields must render as
+                # COUNTERS so the aggregator page carries the same
+                # canonical names (lightgbm_tpu_request_total, ...) as
+                # the process's own exposition — a dashboard built
+                # against one page must match the other
+                role = doc["role"]
+                counter_fields = (ROUTER_COUNTER_FIELDS
+                                  if role == "router"
+                                  else SERVING_COUNTER_FIELDS)
+                labels = {("router" if role == "router"
+                           else "replica"): doc.get("label", "?"),
+                          "role": role}
                 counters = {k: v for k, v in data.items()
-                            if k in SERVING_COUNTER_FIELDS
+                            if k in counter_fields
                             and _num(v) is not None}
                 extra = {k: v for k, v in data.items()
-                         if k not in SERVING_COUNTER_FIELDS
+                         if k not in counter_fields
                          and _num(v) is not None}
                 parts.append((labels, {"counters": counters}, extra))
         fleet = fleet_view(state)
@@ -324,15 +347,31 @@ def fleet_view(state):
     Serving replicas: worst p99 (max is the honest cross-replica p99
     merge — the true fleet p99 lies at or below it), summed
     request/error counts."""
-    fleet = {"train_ranks": 0, "serve_replicas": 0, "unreachable": 0}
+    fleet = {"train_ranks": 0, "serve_replicas": 0, "routers": 0,
+             "unreachable": 0}
     sync_waits, overlaps, prefetch, iters = {}, {}, {}, {}
     p99s, req_total, err_total = [], 0, 0
+    rt_retries = rt_hedges = rt_breaker_opens = rt_shed = 0
+    rt_healthy = []
     for host_port, doc in sorted(state.items()):
         if not doc.get("ok"):
             fleet["unreachable"] += 1
             continue
         data = doc.get("data") or {}
-        if doc["role"] == "train":
+        if doc["role"] == "router":
+            # the front door's own rollup: how hard is the resilience
+            # layer working (retries/hedges/breaker flips) and how much
+            # of the fleet it still considers routable
+            fleet["routers"] += 1
+            rt_retries += int(_num(data.get("retry_count"), 0) or 0)
+            rt_hedges += int(_num(data.get("hedge_count"), 0) or 0)
+            rt_breaker_opens += int(
+                _num(data.get("breaker_open_count"), 0) or 0)
+            rt_shed += int(_num(data.get("no_replica_count"), 0) or 0)
+            healthy = _num(data.get("healthy_replica_count"))
+            if healthy is not None:
+                rt_healthy.append(int(healthy))
+        elif doc["role"] == "train":
             fleet["train_ranks"] += 1
             label = doc.get("label", host_port)
             comm = data.get("comm") or {}
@@ -378,6 +417,13 @@ def fleet_view(state):
     if fleet["serve_replicas"]:
         fleet["request_count"] = req_total
         fleet["error_count"] = err_total
+    if fleet["routers"]:
+        fleet["router_retry_count"] = rt_retries
+        fleet["router_hedge_count"] = rt_hedges
+        fleet["router_breaker_open_count"] = rt_breaker_opens
+        fleet["router_no_replica_count"] = rt_shed
+        if rt_healthy:
+            fleet["router_min_healthy_replicas"] = min(rt_healthy)
     return fleet
 
 

@@ -31,7 +31,9 @@ def nearest_rank(sorted_values, p):
     the serving /metricz latency ring and the fleet load generator's
     gated p99-during-swap must never diverge."""
     n = len(sorted_values)
-    return float(sorted_values[max(0, -(-n * p // 100) - 1)])
+    # int(): a float p (the router's hedge_quantile * 100) floor-divides
+    # to a float rank, which numpy refuses as an index
+    return float(sorted_values[int(max(0, -(-n * p // 100) - 1))])
 
 
 class Counter:

@@ -51,6 +51,29 @@ models one preemption/straggler event, not a permanently broken rank.
 - ``heartbeat_stale`` (rank index; -1 = every rank): the matching
   rank's heartbeat publisher stops writing while training continues —
   models a wedged monitor/filesystem so peers declare it dead.
+
+Serving chaos faults (the resilience layer; serving/server.py,
+serving/batcher.py, fleet/registry.py). These are readable through a
+per-server overrides dict (`serving_chaos`) so a multi-replica chaos
+test can slow ONE in-process replica while its siblings stay healthy —
+the env/API global still applies to every replica that has no
+override:
+
+- ``slow_replica_ms`` (milliseconds): every predict handler sleeps
+  that long before dispatch — a degraded/overcommitted replica.
+- ``error_rate`` (integer percent): that share of predict requests
+  fail with an injected 500. Firing is DETERMINISTIC (Bresenham over a
+  request counter, `error_rate_fires`) so chaos assertions are exact
+  and the nondeterminism lint stays clean — no RNG.
+- ``drop_connection`` (count): the next k predict replies close the
+  socket without writing a response — a torn connection the router
+  must retry elsewhere.
+- ``wedge_batcher`` (flag): the MicroBatcher worker parks before
+  taking work until the fault clears — queue grows, admission control
+  must shed; clearing the fault un-wedges without a restart.
+- ``corrupt_registry_version`` (count): the next k
+  `ModelRegistry.verify` calls raise RegistryError as if the manifest
+  checksums failed — a torn publish the follower must refuse to swap.
 """
 
 import os
@@ -136,6 +159,54 @@ class injected_faults:
         _active.clear()
         _active.update(self._saved)
         return False
+
+
+# ------------------------------------------------------- serving chaos
+
+def serving_chaos(overrides=None):
+    """Merged fault view for the serving layer: the process-global
+    fault set overlaid with a per-server overrides dict (so one
+    in-process replica can be slowed/broken while siblings sharing the
+    process-global table stay healthy)."""
+    merged = dict(_active)
+    if overrides:
+        merged.update(overrides)
+    return merged
+
+
+def consume_from(name, overrides=None):
+    """Count-based consume honoring a per-server overrides dict first:
+    decrements the override counter when the name is overridden there,
+    the global counter otherwise. Negative counters fire forever."""
+    if overrides is not None and name in overrides:
+        count = overrides.get(name)
+        if not isinstance(count, int) or count == 0:
+            return False
+        if count > 0:
+            overrides[name] = count - 1
+        return True
+    return consume(name)
+
+
+def error_rate_fires(state, rate):
+    """Deterministic percent-based firing for ``error_rate``: `rate` is
+    an integer percent; request k fires when floor(k*rate/100) advances
+    (Bresenham), so EXACTLY rate% of requests fail with no RNG — chaos
+    assertions stay exact and reproducible. `state` is a mutable dict
+    owned by the caller (one per server)."""
+    try:
+        rate = int(rate)
+    except (TypeError, ValueError):
+        return False
+    if rate <= 0:
+        return False
+    rate = min(100, rate)
+    state["seen"] = state.get("seen", 0) + 1
+    should_have_fired = (state["seen"] * rate) // 100
+    if should_have_fired > state.get("fired", 0):
+        state["fired"] = should_have_fired
+        return True
+    return False
 
 
 # --------------------------------------------------------- rank targeting

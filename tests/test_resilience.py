@@ -1,0 +1,615 @@
+"""Front-door resilience suite (docs/Resilience.md).
+
+Chaos matrix over the serving stack's failure-containment layers:
+
+- deadline propagation: `X-Deadline-Ms` -> 504 for requests that are
+  already expired, and 504 from the batcher for requests that expire
+  while QUEUED (zero device time spent either way);
+- admission control: 429 + Retry-After when the estimated queue wait
+  exceeds the deadline budget, with brownout (quality monitors off
+  first) engaging before any shed and /healthz + /metricz always on;
+- batcher error isolation: a predictor fault fails one batch's
+  futures, never the worker; in_flight drains on client disconnect;
+- the fleet router (fleet/router.py): breaker state machine, budgeted
+  retries (error amplification capped at 1 + retry_budget), hedging
+  with loser cancellation, strict-health ejection of draining
+  replicas, and survival of a replica killed mid-traffic;
+- chaos fault helpers (utils/faults.py): deterministic error_rate,
+  per-server override merge, count-based consume_from, and the
+  corrupt_registry_version hook the follower refuses to swap on.
+
+Fast legs run tier-1; the full loadgen-under-chaos rung (three
+replicas, one killed + one slowed mid-run) is `slow` and also runs —
+priced — as `bench.py router_probe` under `make verify-resilience`.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.fleet import ModelRegistry, RegistryError
+from lightgbm_tpu.fleet.loadgen import LoadGenerator
+from lightgbm_tpu.fleet.router import (CLOSED, HALF_OPEN, OPEN, Router,
+                                       make_router_server)
+from lightgbm_tpu.serving import CompiledPredictor, make_server
+from lightgbm_tpu.serving.server import drain
+from lightgbm_tpu.telemetry.aggregate import FleetAggregator
+from lightgbm_tpu.utils import faults
+
+
+# --------------------------------------------------------------- fixtures
+@pytest.fixture(autouse=True)
+def _fault_hygiene():
+    """Every test starts and ends with the global fault table empty —
+    a leaked fault must not poison an unrelated test."""
+    faults.clear_faults()
+    yield
+    faults.clear_faults()
+
+
+def _train_binary(n=300, f=5, rounds=8, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 15, "min_data_in_leaf": 5, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, y, params=params),
+                    num_boost_round=rounds, verbose_eval=False)
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    return _train_binary()
+
+
+def _predictor(binary_model, max_batch_rows=32):
+    bst, _ = binary_model
+    return CompiledPredictor.from_booster(bst.gbdt,
+                                          max_batch_rows=max_batch_rows)
+
+
+class _Replica:
+    """One in-process serving replica with its own serve thread and a
+    guaranteed teardown (the suite starts several per test)."""
+
+    def __init__(self, binary_model, **make_kwargs):
+        make_kwargs.setdefault("max_wait_ms", 1.0)
+        self.srv = make_server(_predictor(binary_model), port=0,
+                               **make_kwargs)
+        self.port = self.srv.server_address[1]
+        self.target = f"127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self.srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.alive = True
+
+    def kill(self):
+        if self.alive:
+            self.alive = False
+            self.srv.shutdown()
+            self.srv.server_close()
+            self.srv.batcher.close()
+
+    close = kill
+
+
+def _post(port, rows, deadline_ms=None, path="/predict", timeout=30):
+    """POST rows; returns (status, parsed body, headers). 4xx/5xx come
+    back as statuses, not exceptions — chaos assertions are about
+    WHICH refusal, not whether urllib raised."""
+    headers = {"Content-Type": "application/json"}
+    if deadline_ms is not None:
+        headers["X-Deadline-Ms"] = str(float(deadline_ms))
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps({"rows": np.asarray(rows).tolist()}).encode(),
+        headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {}), dict(e.headers)
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return json.loads(r.read())
+
+
+# ------------------------------------------------------- fault helpers
+def test_error_rate_fires_is_deterministic():
+    """Bresenham firing: EXACTLY rate% of requests fail, no RNG."""
+    state = {}
+    fired = sum(faults.error_rate_fires(state, 25) for _ in range(100))
+    assert fired == 25
+    # a second hundred fires exactly 25 more (no drift)
+    fired += sum(faults.error_rate_fires(state, 25) for _ in range(100))
+    assert fired == 50
+    assert not faults.error_rate_fires({}, 0)
+    assert not faults.error_rate_fires({}, None)
+    assert not faults.error_rate_fires({}, "nope")
+    # rate 100 fires every time
+    assert all(faults.error_rate_fires({"seen": i, "fired": i}, 100)
+               for i in range(5))
+
+
+def test_serving_chaos_override_merge_and_consume_from():
+    faults.set_fault("slow_replica_ms", 100)
+    merged = faults.serving_chaos({"slow_replica_ms": 7, "extra": 1})
+    assert merged["slow_replica_ms"] == 7        # override wins
+    assert merged["extra"] == 1
+    assert faults.serving_chaos()["slow_replica_ms"] == 100
+    # count-based consume honors the override dict first
+    overrides = {"drop_connection": 2}
+    assert faults.consume_from("drop_connection", overrides)
+    assert faults.consume_from("drop_connection", overrides)
+    assert not faults.consume_from("drop_connection", overrides)
+    assert overrides["drop_connection"] == 0
+    # without an override the global counter decrements
+    faults.set_fault("drop_connection", 1)
+    assert faults.consume_from("drop_connection")
+    assert not faults.consume_from("drop_connection")
+
+
+def test_corrupt_registry_version_fault(tmp_path, binary_model):
+    """The chaos hook the promotion path defends against: an injected
+    manifest-verification failure must read as a torn publish
+    (RegistryError), and clear once consumed."""
+    bst, _ = binary_model
+    model = str(tmp_path / "m.txt")
+    bst.save_model(model)
+    registry = ModelRegistry(str(tmp_path / "reg"))
+    v = registry.publish(model)
+    faults.set_fault("corrupt_registry_version", 1)
+    with pytest.raises(RegistryError, match="injected fault"):
+        registry.verify(v)
+    registry.verify(v)   # the count-based fault is spent
+
+
+# ---------------------------------------------------- deadlines + shed
+def test_already_expired_deadline_is_504(binary_model):
+    rep = _Replica(binary_model)
+    try:
+        _, X = binary_model
+        status, body, _ = _post(rep.port, X[:2], deadline_ms=0)
+        assert status == 504
+        assert "expired" in body["error"]
+        snap = _get_json(rep.port, "/metricz")
+        assert snap["deadline_expired_count"] == 1
+        assert snap["shed_count"] == 0
+    finally:
+        rep.kill()
+
+
+def test_deadline_expires_in_queue_504_and_worker_survives(binary_model):
+    """wedge_batcher parks the worker; a queued request whose deadline
+    passes while wedged is dropped BEFORE dispatch (504, zero device
+    time) and the un-wedged worker keeps serving."""
+    rep = _Replica(binary_model)
+    try:
+        _, X = binary_model
+        rep.srv.chaos["wedge_batcher"] = 1
+        result = {}
+
+        def client():
+            result["out"] = _post(rep.port, X[:2], deadline_ms=150)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        time.sleep(0.4)          # deadline passes while wedged
+        del rep.srv.chaos["wedge_batcher"]
+        t.join(timeout=10)
+        status, body, _ = result["out"]
+        assert status == 504
+        assert "queue" in body["error"]
+        assert _get_json(rep.port, "/metricz")["deadline_expired_count"] == 1
+        # the worker took the empty batch in stride: normal traffic flows
+        status, body, _ = _post(rep.port, X[:2])
+        assert status == 200 and len(body["predictions"]) == 2
+    finally:
+        rep.kill()
+
+
+def test_admission_sheds_429_with_retry_after_and_brownout(binary_model):
+    """A deadline the queue cannot possibly meet sheds with 429 before
+    costing a dispatch; brownout engages first (monitors off), the
+    admin endpoints stay up, and deadline-less traffic still serves."""
+    # max_wait_ms=80 makes the cold-start wait estimate ~160 ms, so a
+    # 10 ms budget is deterministically unmeetable with an empty queue
+    rep = _Replica(binary_model, max_wait_ms=80.0)
+    try:
+        _, X = binary_model
+        status, body, headers = _post(rep.port, X[:2], deadline_ms=10)
+        assert status == 429
+        assert body["retry_after_s"] > 0
+        assert int(headers["Retry-After"]) >= 1
+        snap = _get_json(rep.port, "/metricz")   # admin path still up
+        assert snap["shed_count"] == 1
+        assert snap["brownout_active"] == 1      # engaged before the shed
+        assert rep.srv.admission.brownout_active
+        assert _get_json(rep.port, "/healthz")["status"] == "ok"
+        # no deadline = never shed (admission is strictly opt-in), and
+        # the zero-pressure sample releases the brownout
+        status, body, _ = _post(rep.port, X[:2])
+        assert status == 200 and len(body["predictions"]) == 2
+        assert not rep.srv.admission.brownout_active
+        assert _get_json(rep.port, "/metricz")["brownout_active"] == 0
+    finally:
+        rep.kill()
+
+
+# ------------------------------------------------- batcher regressions
+def test_batcher_error_isolated_to_one_batch(binary_model):
+    """A predictor exception during a coalesced dispatch fails only
+    that batch's futures (500 to those clients) — the worker thread
+    survives and the next batch serves normally."""
+    rep = _Replica(binary_model)
+    try:
+        _, X = binary_model
+        batcher = rep.srv.batcher
+        real = batcher.predictor
+
+        class Bomb:
+            max_batch_rows = real.max_batch_rows
+            _canon = getattr(real, "_canon", None)
+
+            def predict(self, rows):
+                raise RuntimeError("injected predictor fault")
+
+        batcher.swap_predictor(Bomb())
+        status, body, _ = _post(rep.port, X[:2])
+        assert status == 500 and "injected predictor fault" in body["error"]
+        batcher.swap_predictor(real)
+        status, body, _ = _post(rep.port, X[:2])
+        assert status == 200 and len(body["predictions"]) == 2
+        snap = _get_json(rep.port, "/metricz")
+        assert snap["error_count"] == 1
+        assert snap["queue_depth"] == 0
+    finally:
+        rep.kill()
+
+
+def test_in_flight_drains_after_client_disconnect(binary_model):
+    """A client tearing its connection mid-request must not leak the
+    in-flight gauge (the drain/quiesce checks hang forever on a leak)."""
+    import http.client
+    rep = _Replica(binary_model)
+    try:
+        _, X = binary_model
+        rep.srv.chaos["slow_replica_ms"] = 400
+        conn = http.client.HTTPConnection("127.0.0.1", rep.port,
+                                          timeout=0.05)
+        body = json.dumps({"rows": X[:2].tolist()}).encode()
+        conn.request("POST", "/predict", body=body,
+                     headers={"Content-Type": "application/json"})
+        with pytest.raises(OSError):
+            conn.getresponse()
+        conn.close()             # client gone; handler still sleeping
+        rep.srv.chaos.clear()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline \
+                and rep.srv.inflight.count != 0:
+            time.sleep(0.02)
+        assert rep.srv.inflight.count == 0
+        assert drain(rep.srv, timeout_s=5.0)
+    finally:
+        rep.kill()
+
+
+def test_drain_is_retryable_and_strict_healthz_ejects(binary_model):
+    """Draining: POSTs bounce 503 + Retry-After, the plain health
+    probe stays 200 (liveness), the STRICT probe goes 503 so the
+    router ejects — and the Router does exactly that."""
+    rep = _Replica(binary_model)
+    try:
+        _, X = binary_model
+        rep.srv.draining = True
+        status, body, headers = _post(rep.port, X[:2])
+        assert status == 503 and "draining" in body["error"]
+        assert headers["Retry-After"] == "1"
+        health = _get_json(rep.port, "/healthz")
+        assert health["draining"] is True
+        assert health["status"] == "draining"
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{rep.port}/healthz?strict=1",
+                timeout=30)
+            strict = 200
+        except urllib.error.HTTPError as e:
+            strict = e.code
+        assert strict == 503
+
+        router = Router([rep.target], health_poll_s=0.1)
+        router.probe_health()
+        assert router.replicas[0].ejected
+        rep.srv.draining = False
+        router.probe_health()
+        assert not router.replicas[0].ejected
+    finally:
+        rep.kill()
+
+
+# --------------------------------------------------------------- router
+def test_breaker_state_machine():
+    """closed -> open after N consecutive failures -> timed half-open
+    single probe -> closed on success / re-open on failure. Driven
+    directly: no sockets, no sleep-dependent races beyond reset_s."""
+    router = Router(["127.0.0.1:1", "127.0.0.1:2"],
+                    breaker_failures=2, breaker_reset_s=0.1)
+    a, b = router.replicas
+    assert a.breaker == CLOSED
+    router.on_failure(a)
+    assert a.breaker == CLOSED      # one failure is not a pattern
+    router.on_failure(a)
+    assert a.breaker == OPEN
+    assert router.pick(exclude=(b,)) is None     # open = not picked
+    time.sleep(0.15)
+    probe = router.pick(exclude=(b,))            # reset window passed
+    assert probe is a and a.breaker == HALF_OPEN
+    assert router.pick(exclude=(b,)) is None     # one probe at a time
+    router.on_failure(a)                          # probe failed
+    assert a.breaker == OPEN
+    time.sleep(0.15)
+    assert router.pick(exclude=(b,)) is a
+    router.on_success(a)                          # probe succeeded
+    assert a.breaker == CLOSED and a.consecutive_failures == 0
+    snap = router.snapshot()
+    assert snap["breaker_open_count"] == 2
+    assert snap["breaker_close_count"] == 1
+    # a 429/504 refusal is the protocol WORKING: the dispatch loop
+    # only counts transport errors and retryable 5xx as failures
+    from lightgbm_tpu.fleet.router import RETRYABLE_STATUSES
+    assert 429 not in RETRYABLE_STATUSES
+    assert 504 not in RETRYABLE_STATUSES
+
+
+def test_router_retries_dropped_connection(binary_model):
+    """drop_connection on replica A tears the socket mid-request; the
+    router retries the SAME request on replica B and the client sees
+    one clean 200."""
+    a = _Replica(binary_model)
+    b = _Replica(binary_model)
+    rsrv = make_router_server([a.target, b.target], port=0,
+                              retry_budget=1.0, health_poll_s=30.0)
+    rthread = threading.Thread(target=rsrv.serve_forever, daemon=True)
+    rthread.start()
+    rport = rsrv.server_address[1]
+    try:
+        _, X = binary_model
+        a.srv.chaos["drop_connection"] = 1
+        status, body, _ = _post(rport, X[:3])
+        assert status == 200 and len(body["predictions"]) == 3
+        snap = _get_json(rport, "/metricz")
+        assert snap["router"] is True
+        assert snap["retry_count"] >= 1
+        assert snap["request_count"] == 1
+        # front-door health reflects the replica table
+        assert _get_json(rport, "/healthz")["status"] == "ok"
+    finally:
+        rsrv.shutdown()
+        rsrv.router.stop()
+        rsrv.server_close()
+        a.kill()
+        b.kill()
+
+
+def test_router_survives_replica_killed_mid_traffic(binary_model):
+    """Kill one of two replicas; every subsequent request still gets
+    200 (failover + breaker), the breaker visibly opens, and the
+    health sweep ejects the corpse."""
+    a = _Replica(binary_model)
+    b = _Replica(binary_model)
+    router = Router([a.target, b.target], breaker_failures=2,
+                    breaker_reset_s=60.0, retry_budget=1.0,
+                    health_poll_s=0.2)
+    try:
+        _, X = binary_model
+        body = json.dumps({"rows": X[:2].tolist()}).encode()
+        headers = {"Content-Type": "application/json",
+                   "Content-Length": str(len(body))}
+        status, _, _ = router.dispatch("/predict", body, headers)
+        assert status == 200
+        a.kill()                      # replica gone, mid-traffic
+        statuses = [router.dispatch("/predict", body, headers)[0]
+                    for _ in range(6)]
+        assert statuses == [200] * 6  # zero 5xx reached the client
+        snap = router.snapshot()
+        assert snap["breaker_open_count"] >= 1
+        assert snap["retry_count"] >= 1
+        assert snap["upstream_attempt_count"] <= 7 + 4  # budget-capped
+        router.probe_health()
+        snap = router.snapshot()
+        assert snap["healthy_replica_count"] == 1
+        dead = [r for r in snap["replicas"] if r["target"] == a.target]
+        assert dead[0]["ejected"] or dead[0]["breaker"] == "open"
+    finally:
+        router.stop()
+        a.kill()
+        b.kill()
+
+
+def test_router_error_amplification_capped_by_budget(binary_model):
+    """With EVERY replica failing, upstream attempts stay within
+    1 + retry_budget per request (plus the initial token) — retries
+    must never multiply a fleet-wide outage."""
+    a = _Replica(binary_model)
+    b = _Replica(binary_model)
+    router = Router([a.target, b.target], breaker_failures=100,
+                    retry_budget=0.5, retry_jitter_ms=0.0)
+    try:
+        _, X = binary_model
+        a.srv.chaos["error_rate"] = 100
+        b.srv.chaos["error_rate"] = 100
+        body = json.dumps({"rows": X[:2].tolist()}).encode()
+        headers = {"Content-Type": "application/json",
+                   "Content-Length": str(len(body))}
+        n = 10
+        statuses = [router.dispatch("/predict", body, headers)[0]
+                    for _ in range(n)]
+        assert all(s == 500 for s in statuses)   # honest, not amplified
+        snap = router.snapshot()
+        assert snap["request_count"] == n
+        # hard bound: n + retries, retries <= initial 1.0 + n * budget
+        assert snap["upstream_attempt_count"] <= n + 1 + int(n * 0.5)
+        assert snap["upstream_attempt_count"] >= n
+    finally:
+        router.stop()
+        a.kill()
+        b.kill()
+
+
+def test_router_hedges_slow_replica_and_cancels_loser(binary_model):
+    """After the latency ring warms, a request stuck on a slowed
+    replica fires one hedge at a sibling; the fast answer wins and the
+    loser's socket is torn down."""
+    a = _Replica(binary_model)
+    b = _Replica(binary_model)
+    router = Router([a.target, b.target], breaker_failures=100,
+                    retry_budget=1.0, hedge_quantile=0.5)
+    try:
+        _, X = binary_model
+        body = json.dumps({"rows": X[:2].tolist()}).encode()
+        headers = {"Content-Type": "application/json",
+                   "Content-Length": str(len(body))}
+        for _ in range(25):          # warm the ring past MIN_HEDGE_SAMPLES
+            assert router.dispatch("/predict", body, headers)[0] == 200
+        assert router.snapshot()["hedge_count"] == 0
+        a.srv.chaos["slow_replica_ms"] = 800
+        t0 = time.monotonic()
+        status, _, data = router.dispatch("/predict", body, headers)
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert len(json.loads(data)["predictions"]) == 2
+        assert elapsed < 0.7         # the hedge answered, not the sleeper
+        snap = router.snapshot()
+        assert snap["hedge_count"] == 1
+        assert snap["hedge_cancelled_count"] >= 1
+    finally:
+        a.srv.chaos.clear()
+        router.stop()
+        a.kill()
+        b.kill()
+
+
+def test_router_no_replica_is_503_retry_after():
+    """Every replica ejected: refuse fast with 503 + Retry-After (and
+    the front-door /healthz goes non-200) instead of hanging."""
+    router = Router(["127.0.0.1:9"], health_poll_s=0.1)
+    try:
+        router.probe_health()        # nothing listening -> ejected
+        status, headers, data = router.dispatch(
+            "/predict", b"{}", {"Content-Type": "application/json"})
+        assert status == 503
+        assert headers["Retry-After"] == "1"
+        assert "no healthy replica" in json.loads(data)["error"]
+        snap = router.snapshot()
+        assert snap["no_replica_count"] == 1
+        assert snap["healthy_replica_count"] == 0
+        assert snap["eject_count"] == 1
+    finally:
+        router.stop()
+
+
+def test_router_deadline_expires_at_router():
+    """An expired X-Deadline-Ms never costs an upstream attempt."""
+    router = Router(["127.0.0.1:9"])
+    try:
+        status, _, data = router.dispatch(
+            "/predict", b"{}", {"X-Deadline-Ms": "0"})
+        assert status == 504
+        assert "deadline" in json.loads(data)["error"]
+        snap = router.snapshot()
+        assert snap["deadline_expired_count"] == 1
+        assert snap["upstream_attempt_count"] == 0
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------- fleet aggregation
+def test_aggregator_scrapes_router_role(binary_model):
+    """The PR-12 aggregator auto-detects the router's /metricz (the
+    `"router": true` marker), renders its counters under the router
+    role and rolls them into the fleet view."""
+    rep = _Replica(binary_model)
+    rsrv = make_router_server([rep.target], port=0, retry_budget=1.0,
+                              health_poll_s=30.0)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    rport = rsrv.server_address[1]
+    try:
+        _, X = binary_model
+        assert _post(rport, X[:2])[0] == 200
+        agg = FleetAggregator([f"127.0.0.1:{rport}", rep.target],
+                              poll_s=0.2, timeout_s=5.0)
+        snap = agg.poll_once()
+        fleet = snap["fleet"]
+        assert fleet["routers"] == 1
+        assert fleet["serve_replicas"] == 1
+        assert fleet["router_min_healthy_replicas"] == 1
+        assert fleet["router_retry_count"] == 0
+        roles = sorted(d["role"] for d in snap["targets"].values())
+        assert roles == ["router", "serve"]
+        page = agg.prometheus()
+        assert 'role="router"' in page
+        # canonical prometheus naming on the merged page (PR-13 lint)
+        assert "lightgbm_tpu_request_total" in page
+        assert "_count_total" not in page
+    finally:
+        rsrv.shutdown()
+        rsrv.router.stop()
+        rsrv.server_close()
+        rep.kill()
+
+
+# ------------------------------------------------------ full chaos rung
+@pytest.mark.slow
+def test_chaos_rung_loadgen_through_router(binary_model):
+    """The acceptance rung, in miniature: three replicas behind the
+    router, sustained deadlined traffic; mid-run one replica is KILLED
+    and another slowed 10x. Well-deadlined clients see zero 5xx, error
+    amplification stays under 1.05x, and the breaker visibly opens."""
+    _, X = binary_model
+    reps = [_Replica(binary_model) for _ in range(3)]
+    rsrv = make_router_server([r.target for r in reps], port=0,
+                              breaker_failures=3, breaker_reset_s=0.5,
+                              retry_budget=1.0, health_poll_s=0.2)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    rport = rsrv.server_address[1]
+    try:
+        gen = LoadGenerator(f"http://127.0.0.1:{rport}",
+                            [X[:4], X[4:8]], qps=60.0, workers=8,
+                            duration_s=4.0, timeout_s=10.0,
+                            deadline_ms=2000.0)
+        gen.run(background=True)
+        time.sleep(1.0)
+        gen.mark_start("chaos")
+        reps[2].kill()                               # hard death
+        reps[1].srv.chaos["slow_replica_ms"] = 60    # ~10x typical
+        time.sleep(1.5)
+        gen.mark_end("chaos")
+        gen.join(timeout=30)
+        report = gen.report(swap_mark="chaos")
+        assert report["requests"] > 0
+        assert report["server_errors_5xx"] == 0, report["status_counts"]
+        assert report["status_counts"].get(0, 0) == 0, report["errors"]
+        snap = _get_json(rport, "/metricz")
+        amplification = (snap["upstream_attempt_count"]
+                         / max(1, snap["request_count"]))
+        assert amplification <= 1.05, snap
+        assert snap["breaker_open_count"] >= 1 or any(
+            r["ejected"] for r in snap["replicas"])
+        assert snap["healthy_replica_count"] >= 1
+    finally:
+        rsrv.shutdown()
+        rsrv.router.stop()
+        rsrv.server_close()
+        for r in reps:
+            r.kill()

@@ -409,6 +409,7 @@ def test_serving_metrics_parity_after_registry_refactor():
     snap = m.snapshot()
     assert set(snap) == {
         "uptime_s", "request_count", "rows_served", "error_count",
+        "shed_count", "deadline_expired_count", "brownout_active",
         "batch_count", "batch_occupancy_rows",
         "batch_occupancy_requests", "latency_p50_ms", "latency_p95_ms",
         "latency_p99_ms", "latency_window"}

@@ -53,6 +53,7 @@ MAD_SCALE = 1.4826   # MAD -> sigma for normal noise
 # not noise)
 TRACKED = (("train_s", "down", None),
            ("serving_p99_ms", "down", None),
+           ("router_p99_under_chaos_ms", "down", None),
            ("peak_memory_bytes", "down", None),
            ("collective_bytes_per_tree", "down", 0.05),
            ("auc", "up", 0.005),

@@ -449,7 +449,118 @@ def check_fleet():
     return ok
 
 
+def check_router():
+    """Front-door resilience guard (`make verify-resilience`; the
+    bench's router_probe in gate form): three replicas behind the
+    fleet router with a mid-run kill + 10x slow + transient error
+    burst must (1) deliver ZERO 5xx and ZERO transport errors to the
+    well-deadlined clients, (2) keep error amplification at or under
+    VERIFY_ROUTER_AMP (default 1.05 — the retry budget's contract),
+    (3) keep p99 UNDER CHAOS within VERIFY_ROUTER_CHAOS_FACTOR
+    (default 3.0) of steady p99 and within VERIFY_ROUTER_TOL (default
+    50%) of the committed router_p99_under_chaos_ms baseline, and
+    (4) show the breaker both OPEN and RE-CLOSE on the router's own
+    /metricz counters."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import bench
+    res = bench.router_probe(
+        timeout_s=int(os.environ.get("VERIFY_ROUTER_TIMEOUT", "480")))
+    if "error" in res:
+        print(f"verify-router: probe failed: {res['error']}")
+        return False
+    ok = True
+    print(f"verify-router: {res['requests']} requests @ "
+          f"{res['achieved_qps']:.0f} qps, steady p50/p99 "
+          f"{res['steady_p50_ms']:.1f}/{res['steady_p99_ms']:.1f} ms, "
+          f"chaos p99 {res['p99_under_chaos_ms']:.1f} ms over "
+          f"{res['chaos_window_requests']} request(s), shed rate "
+          f"{res['shed_rate']:.3f}")
+    # sample floor: a wedged run makes every latency gate pass
+    # vacuously, so thin runs FAIL loudly (same rule as verify-fleet)
+    min_requests = int(os.environ.get("VERIFY_ROUTER_MIN_REQUESTS",
+                                      "400"))
+    min_window = int(os.environ.get("VERIFY_ROUTER_MIN_CHAOS_SAMPLES",
+                                    "30"))
+    if (res["requests"] < min_requests
+            or res["chaos_window_requests"] < min_window):
+        print(f"verify-router: only {res['requests']} request(s), "
+              f"{res['chaos_window_requests']} in the chaos window "
+              f"(floors {min_requests}/{min_window}) -> "
+              "INSUFFICIENT SAMPLES")
+        ok = False
+    bad = res["server_errors_5xx"] + res["transport_errors"]
+    if bad:
+        print(f"verify-router: {res['server_errors_5xx']} 5xx + "
+              f"{res['transport_errors']} transport error(s) reached "
+              f"clients ({res['status_counts']}) -> ERRORS AMPLIFIED "
+              "PAST THE FRONT DOOR")
+        ok = False
+    else:
+        print("verify-router: zero 5xx / transport errors reached "
+              "clients across the kill + slow + error burst -> OK")
+    amp_limit = float(os.environ.get("VERIFY_ROUTER_AMP", "1.05"))
+    amp = res["error_amplification"]
+    if amp > amp_limit:
+        print(f"verify-router: error amplification {amp:.3f}x > "
+              f"{amp_limit:.2f}x (retry budget leak) -> RETRY STORM")
+        ok = False
+    else:
+        print(f"verify-router: error amplification {amp:.3f}x "
+              f"(limit {amp_limit:.2f}x; {res['retry_count']} retries) "
+              "-> OK")
+    factor = float(os.environ.get("VERIFY_ROUTER_CHAOS_FACTOR", "3.0"))
+    during, steady = res["p99_under_chaos_ms"], res["steady_p99_ms"]
+    limit = factor * steady
+    if during > limit:
+        print(f"verify-router: p99 under chaos {during:.1f} ms > "
+              f"{factor:.1f}x steady p99 {steady:.1f} ms -> CHAOS "
+              "DISTURBS HEALTHY TRAFFIC")
+        ok = False
+    else:
+        print(f"verify-router: p99 under chaos {during:.1f} ms vs "
+              f"steady {steady:.1f} ms (limit {limit:.1f} ms) -> OK")
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    base_chaos = base.get("router_p99_under_chaos_ms")
+    if base_chaos:
+        tol = float(os.environ.get("VERIFY_ROUTER_TOL", "0.50"))
+        blimit = base_chaos * (1.0 + tol)
+        good = during <= blimit
+        print(f"verify-router: p99 under chaos {during:.1f} ms vs "
+              f"baseline {base_chaos:.1f} ms (limit {blimit:.1f} ms) "
+              f"-> {'OK' if good else 'REGRESSION'}")
+        ok = ok and good
+    else:
+        print("verify-router: baseline has no router_p99_under_chaos_ms"
+              " — regression gate skipped (bump BENCH_BASELINE.json to "
+              "arm)")
+    if res["breaker_open_count"] < 1 or res["breaker_close_count"] < 1:
+        print(f"verify-router: breaker opened {res['breaker_open_count']}"
+              f"x / re-closed {res['breaker_close_count']}x — the chaos "
+              "script guarantees at least one full open -> half-open -> "
+              "close cycle -> BREAKER NOT EXERCISED")
+        ok = False
+    else:
+        print(f"verify-router: breaker opened "
+              f"{res['breaker_open_count']}x and re-closed "
+              f"{res['breaker_close_count']}x (ejects "
+              f"{res['eject_count']}) -> OK")
+    if res["healthy_replica_count_end"] < 1:
+        print("verify-router: no healthy replica left at run end -> "
+              "FLEET DID NOT RECOVER")
+        ok = False
+    return ok
+
+
 def main():
+    if "--router" in sys.argv:
+        if not check_router():
+            print("verify-router: FAILED")
+            return 1
+        print("verify-router: all checks passed")
+        return 0
     if "--fleet" in sys.argv:
         if not check_fleet():
             print("verify-fleet: FAILED")
