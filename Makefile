@@ -41,8 +41,8 @@ verify-lint:
 # the default CI aggregate: every verify target, cheapest gate first
 # (a lint violation fails in seconds, before any training run starts)
 verify: verify-lint verify-fault verify-serve verify-obs verify-quality \
-	verify-perf verify-ooc verify-fleet verify-resilience verify-dist \
-	verify-dist-perf
+	verify-perf verify-ooc verify-elastic verify-fleet verify-resilience \
+	verify-dist verify-dist-perf
 
 # fault-injection suite: checkpoint/resume determinism, corrupt-snapshot
 # fallback, non-finite guardrails, distributed-init hardening
@@ -136,6 +136,19 @@ verify-ooc:
 	  tests/test_out_of_core.py -q
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --ooc
 
+# elastic out-of-core suite: shared-store gang ownership math,
+# preemption/bit-rot fault injection, shrink/grow chaos rungs
+# (tests/test_elastic_ooc.py tier-1 portion) — then the acceptance
+# guard (bench elastic_probe via tools/verify_perf.py --elastic: one
+# binning pass across cold -> snapshot-resume -> 2-process gang over
+# the SAME block store, resume cheaper than re-binning, comm +
+# prefetch overlap both attributed on the gang run)
+verify-elastic:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_elastic_ooc.py tests/test_single_core.py -q -m 'not slow' \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+	timeout -k 10 900 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --elastic
+
 # front-door resilience suite (docs/Resilience.md): deadline
 # propagation + queue shedding + brownout, chaos-fault determinism,
 # circuit-breaker state machine, retry/hedge budgets, plus the slow
@@ -157,4 +170,4 @@ clean:
 
 .PHONY: all test-capi verify verify-lint verify-fault verify-dist \
 	verify-dist-perf verify-serve verify-obs verify-perf verify-quality \
-	verify-fleet verify-ooc verify-resilience clean
+	verify-fleet verify-ooc verify-elastic verify-resilience clean

@@ -62,6 +62,16 @@ import time
 
 import numpy as np
 
+# 1-core runners: give the XLA CPU client a second virtual device so
+# the histogram engine's host callbacks always have a worker thread —
+# without it the fused/compacted bincount programs deadlock (see
+# lightgbm_tpu/utils/hostenv.py). Must run before the first jax use;
+# child processes re-run this at their own startup.
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lightgbm_tpu.utils.hostenv import ensure_callback_worker_devices
+
+ensure_callback_worker_devices()
+
 # Reference CLI training-loop time at 1M x 28 x 100 iters x 63 leaves,
 # re-measured round 4 on THIS container (single core, -O3, training AUC
 # 0.933776, metric evals excluded like our timed loop; round 3 recorded
@@ -1524,6 +1534,224 @@ def dist_probe(timeout_s=600):
     return out
 
 
+def run_elastic_child():
+    """Elastic out-of-core probe worker (`bench.py --elastic-child`):
+    one CLI-equivalent training run (lightgbm_tpu.application.main)
+    against the shared block store, wall-timed end to end (data
+    open/bin + train + model save — interpreter/jax import excluded).
+    Modes (BENCH_ELASTIC_MODE): `cold` builds the store and pays the
+    full iteration budget; `resume` restarts in the same dirs, picking
+    up the surviving mid-run snapshot and adopting the already-built
+    store (zero re-bin); `gang` is one rank of a 2-process gloo gang
+    (tree_learner=data num_machines=2 out_of_core=true) adopting the
+    SAME store. Prints one ``ELASTIC_CHILD {json}`` line with the wall
+    seconds, the manifest's lifetime build_count (the re-bin ledger
+    the parent gates on) and the saved model's tree count."""
+    mode = os.environ["BENCH_ELASTIC_MODE"]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # persistent compile cache (as run_child): after the first-ever
+    # run every leg hits the cache, so cold-vs-resume compares the
+    # binning pass + iteration budget rather than XLA compiles
+    cache_dir = os.environ.setdefault(
+        "LIGHTGBM_TPU_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    store = os.environ["BENCH_ELASTIC_DIR"]
+    iters = int(os.environ.get("BENCH_ELASTIC_ITERS", "8"))
+    model = os.environ["BENCH_ELASTIC_MODEL"]
+    args = [
+        "task=train",
+        f"data={os.environ['BENCH_ELASTIC_DATA']}",
+        "objective=binary", "num_leaves=15", "min_data_in_leaf=20",
+        "metric_freq=0", "enable_load_from_binary_file=false",
+        "out_of_core=true", f"ooc_dir={store}",
+        f"block_rows={os.environ.get('BENCH_ELASTIC_BLOCK_ROWS', '2048')}",
+        "device_row_chunk=4096", "hist_compaction=false",
+        f"num_iterations={iters}",
+        f"snapshot_freq={max(iters // 2, 1)}",
+        f"snapshot_dir={os.environ['BENCH_ELASTIC_SNAPS']}",
+        f"output_model={model}",
+    ]
+    if mode == "gang":
+        args += [
+            "tree_learner=data", "num_machines=2",
+            f"machine_list_file={os.environ['BENCH_ELASTIC_MLIST']}",
+            # armed sync points bound a hung peer and measure waits
+            "collective_timeout_s=300",
+            "telemetry=true",
+            f"telemetry_dir={os.environ['BENCH_ELASTIC_TDIR']}",
+        ]
+    from lightgbm_tpu.application import main as app_main
+    t0 = time.time()
+    app_main(args)
+    wall = time.time() - t0
+    res = {"mode": mode, "wall_s": round(wall, 3),
+           "rank": int(os.environ.get("LIGHTGBM_TPU_RANK", "0"))}
+    try:
+        with open(os.path.join(store, "manifest.json")) as f:
+            res["build_count"] = int(json.load(f)["build_count"])
+    except Exception:
+        res["build_count"] = None
+    try:
+        res["trees"] = open(model).read().count("Tree=")
+    except Exception:
+        res["trees"] = None
+    print("ELASTIC_CHILD " + json.dumps(res), flush=True)
+
+
+def elastic_probe(timeout_s=600):
+    """Elastic out-of-core probe (`bench.py elastic_probe`): the
+    restart economics the elastic gang rests on (docs/Out-of-Core.md).
+    Three CLI-equivalent subprocess legs over ONE shared block store:
+    (1) `cold` builds the store and trains the full budget — what a
+    recovery that re-bins from the CSV costs (`cold_rebin_s`);
+    (2) `resume` restarts from the surviving mid-run snapshot and
+    adopts the store — the elastic path (`resume_s`; the manifest's
+    lifetime build_count must not advance); (3) `gang` re-opens the
+    SAME store as a 2-process gloo gang (the grow path, still no
+    re-bin), reporting `ooc_dist.rows_s` plus `comm_overlap_pct` AND
+    `prefetch_overlap_pct` from one run's journal. tools/verify_perf.py
+    --elastic gates these numbers against BENCH_BASELINE.json."""
+    import socket
+    import tempfile
+
+    rows = int(os.environ.get("BENCH_ELASTIC_ROWS", "24000"))
+    iters = int(os.environ.get("BENCH_ELASTIC_ITERS", "8"))
+    d = tempfile.mkdtemp(prefix="bench_elastic_")
+    out = {"rows": rows, "iters": iters}
+    try:
+        _mark(f"elastic probe: writing {rows}-row CSV")
+        x, y = make_data(rows)
+        csv = os.path.join(d, "tr.csv")
+        np.savetxt(csv, np.column_stack([y, x]), delimiter=",",
+                   fmt="%.6f")
+        store = os.path.join(d, "store")
+        snaps = os.path.join(d, "snaps")
+
+        base_env = {
+            "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+            # 2 virtual host devices: same hazard shim the CLI entry
+            # applies on 1-core runners (utils/hostenv)
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "BENCH_ELASTIC_DATA": csv, "BENCH_ELASTIC_DIR": store,
+            "BENCH_ELASTIC_ITERS": str(iters),
+        }
+
+        def spawn(mode, env_extra):
+            env = dict(os.environ)
+            env.pop("LIGHTGBM_TPU_FAULTS", None)
+            env.pop("LIGHTGBM_TPU_RESTART_ATTEMPT", None)
+            env.update(base_env)
+            env.update(env_extra, BENCH_ELASTIC_MODE=mode)
+            return subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--elastic-child"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        def parse(proc, what):
+            try:
+                text, _ = proc.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                raise RuntimeError(f"elastic child ({what}) timed out")
+            for line in text.splitlines():
+                if line.startswith("ELASTIC_CHILD "):
+                    return json.loads(line.split(" ", 1)[1])
+            raise RuntimeError(f"elastic child ({what}) produced no "
+                               f"result (rc={proc.returncode}): "
+                               f"{text[-300:]}")
+
+        _mark("elastic probe: cold leg (bin + full budget)")
+        cold = parse(spawn("cold", {
+            "BENCH_ELASTIC_SNAPS": snaps,
+            "BENCH_ELASTIC_MODEL": os.path.join(d, "model_cold.txt"),
+        }), "cold")
+        # keep only the mid-run snapshot: the resume leg must restart
+        # from iteration iters/2 the way a preempted run would
+        keep = f"snapshot.iter{iters // 2:08d}.ckpt"
+        for name in os.listdir(snaps):
+            if name.startswith("snapshot.") and name != keep:
+                os.remove(os.path.join(snaps, name))
+
+        _mark("elastic probe: resume leg (snapshot + store adopt)")
+        resume = parse(spawn("resume", {
+            "BENCH_ELASTIC_SNAPS": snaps,
+            "BENCH_ELASTIC_MODEL": os.path.join(d, "model_resume.txt"),
+        }), "resume")
+
+        _mark("elastic probe: 2-process gang leg over the same store")
+        port = socket.socket()
+        port.bind(("127.0.0.1", 0))
+        base_port = port.getsockname()[1]
+        port.close()
+        mlist = os.path.join(d, "mlist.txt")
+        with open(mlist, "w") as f:
+            f.write(f"127.0.0.1 {base_port}\n127.0.0.1 {base_port + 1}\n")
+        tdir = os.path.join(d, "telemetry")
+        gang_env = {
+            "BENCH_ELASTIC_SNAPS": os.path.join(d, "snaps_gang"),
+            "BENCH_ELASTIC_MODEL": os.path.join(d, "model_gang.txt"),
+            "BENCH_ELASTIC_MLIST": mlist, "BENCH_ELASTIC_TDIR": tdir,
+        }
+        procs = [spawn("gang", dict(gang_env,
+                                    LIGHTGBM_TPU_RANK=str(r)))
+                 for r in range(2)]
+        gang_ranks = [parse(p, f"gang rank{r}")
+                      for r, p in enumerate(procs)]
+        gang = gang_ranks[0]
+
+        # overlap attribution from the SAME gang run: the per-rank
+        # journal carries both the prefetcher's compute overlap
+        # (iteration records) and the collective-wait overlap (comm
+        # records, telemetry/comm_profile.py)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from lightgbm_tpu.telemetry.journal import (journal_path,
+                                                    read_journal)
+        records, _bad = read_journal(journal_path(tdir, 0))
+        pf = [r["prefetch_overlap_pct"] for r in records
+              if r.get("event") == "iteration"
+              and r.get("prefetch_overlap_pct") is not None]
+        comm = [r["overlap_pct"] for r in records
+                if r.get("event") == "comm"
+                and r.get("overlap_pct") is not None]
+        gang_rows_s = rows * iters / max(gang["wall_s"], 1e-9)
+        out.update({
+            "cold_rebin_s": cold["wall_s"],
+            "resume_s": resume["wall_s"],
+            "resume_speedup": round(
+                cold["wall_s"] / max(resume["wall_s"], 1e-9), 2),
+            "build_count_cold": cold["build_count"],
+            "build_count_resume": resume["build_count"],
+            "resume_trees": resume["trees"],
+            "ooc_dist": {
+                "rows_s": round(gang_rows_s, 1),
+                "train_s": gang["wall_s"],
+                "build_count": gang["build_count"],
+                "trees": gang["trees"],
+                "comm_overlap_pct": (round(sum(comm) / len(comm), 2)
+                                     if comm else None),
+                "prefetch_overlap_pct": (round(sum(pf) / len(pf), 2)
+                                         if pf else None),
+            },
+        })
+        # top-level mirrors so append_history picks them up
+        out["train_s"] = gang["wall_s"]
+        out["comm_overlap_pct"] = out["ooc_dist"]["comm_overlap_pct"]
+        append_history("bench_elastic", out)
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"elastic probe failed: {e}")
+        out["error"] = str(e)[-250:]
+    finally:
+        import shutil
+        shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def append_history(kind, res):
     """One `run_summary` record per measured rung into the repo's
     RUN_HISTORY.jsonl (telemetry/history.py) — the trend line
@@ -1876,6 +2104,13 @@ def main():
         return
     if "--dist-child" in sys.argv:
         run_dist_child()
+        return
+    if "--elastic-child" in sys.argv:
+        run_elastic_child()
+        return
+    if "elastic_probe" in sys.argv:
+        # standalone elastic-resume probe: `python bench.py elastic_probe`
+        print(json.dumps({"elastic": elastic_probe()}), flush=True)
         return
     if "dist_probe" in sys.argv:
         # standalone comms probe: `python bench.py dist_probe`

@@ -416,6 +416,10 @@ class Config:
     ooc_dir: str = ""
     # verify each block's manifest digest on its first read
     ooc_verify: bool = True
+    # gang training over one shared store: seconds non-zero ranks wait
+    # for rank 0's build to publish a signature-matching manifest
+    # before giving up (data/block_store.py load_block_store_gang)
+    ooc_build_wait_s: float = 600.0
 
     # derived from tree_learner/num_machines in check_param_conflict,
     # not user knobs — exempt from the Parameters.md row requirement

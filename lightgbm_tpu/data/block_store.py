@@ -34,6 +34,7 @@ checkpoint loader.
 
 import json
 import os
+import time
 
 import numpy as np
 
@@ -138,9 +139,14 @@ class BlockStoreWriter:
         self.num_rows += self._fill
         self._fill = 0
 
-    def finish(self, sidecar_arrays, source=None, binning=None):
+    def finish(self, sidecar_arrays, source=None, binning=None,
+               build_count=1):
         """Flush the tail block, write the sidecar, then the manifest
-        (last — its presence IS the store's validity marker)."""
+        (last — its presence IS the store's validity marker).
+        `build_count` is the lifetime number of binning passes this
+        directory has seen (previous manifest's count + 1) — the
+        elastic-restart tests assert it stays 1 across a whole
+        shrink/resume cycle (zero re-binning)."""
         self._flush()
         sidecar_path = os.path.join(self.directory, SIDECAR_NAME)
         import io as _io
@@ -159,6 +165,7 @@ class BlockStoreWriter:
                         "crc32": int(crc32_file(sidecar_path))},
             "source": source,
             "binning": binning,
+            "build_count": int(build_count),
         }
         _atomic_write_bytes(
             os.path.join(self.directory, MANIFEST_NAME),
@@ -254,6 +261,28 @@ class BlockStore:
 
     def block_rows_of(self, i):
         return int(self.blocks[i]["rows"])
+
+    def row_start_of(self, i):
+        return int(self.blocks[i]["row_start"])
+
+    def reverify(self, lo, hi):
+        """Force a fresh crc32 check of blocks [lo, hi) NOW, discarding
+        their verified-once cache entries. The post-restart re-check
+        (data/ooc_learner.py: a resuming rank re-verifies the blocks it
+        NOW owns before first use — its ownership may have widened
+        across an elastic re-shard, and the store sat on disk through a
+        kill): bit-rot between attempts must surface as a named
+        BlockStoreError here, not as silent garbage histograms."""
+        from ..utils import faults
+        faults.bitrot_block_if_armed(self._block_path, lo, hi)
+        was_verify = self.verify
+        self.verify = True
+        try:
+            for i in range(int(lo), int(hi)):
+                self._verified.discard(i)
+                self._verify_block(i)
+        finally:
+            self.verify = was_verify
 
     def read_block(self, i):
         """Read-only (num_stored, rows) memmap of block i (digest
@@ -491,6 +520,17 @@ def build_block_store_from_file(loader, filename, directory):
                                 collect_sample_rows)
     from ..utils.random import Random
     cfg = loader.config
+    # lifetime binning-pass counter: survives rebuilds (the writer wipes
+    # the stale manifest, so read it first). Elastic restarts assert it
+    # never advances — survivors adopt blocks, they do not re-bin.
+    build_count = 1
+    prior = os.path.join(directory, MANIFEST_NAME)
+    if os.path.exists(prior):
+        try:
+            with open(prior, "r") as f:
+                build_count = int(json.load(f).get("build_count", 0)) + 1
+        except (OSError, ValueError):
+            build_count = 1
     fmt = detect_format(filename)
     n, names, num_cols = scan_file(filename, fmt, cfg.has_header)
     if n == 0:
@@ -592,11 +632,22 @@ def build_block_store_from_file(loader, filename, directory):
             mappers, real_idx, proto.feature_names, occ, n, missing=miss)
     writer.finish(_sidecar_arrays(proto),
                   source=source_signature(filename),
-                  binning=_binning_signature(cfg))
+                  binning=_binning_signature(cfg),
+                  build_count=build_count)
     Log.info("Built block store %s: %d rows x %d features, %d blocks "
              "of %d rows (%s)", str(directory), n, len(mappers),
              len(writer._blocks), writer.block_rows,
              np.dtype(dtype).name)
+    # a journal is usually not open yet at load time (the booster opens
+    # it later), so the manifest's build_count is the durable record —
+    # but when one IS current (in-process tests, rebuilds mid-run),
+    # the binning pass lands on the timeline too
+    from ..telemetry import journal as run_journal
+    j = run_journal.current()
+    if j is not None:
+        j.event("binning", rows=int(n), blocks=len(writer._blocks),
+                directory=str(directory), features=len(mappers),
+                build_count=int(build_count))
 
 
 def open_block_store_dataset(directory, verify=True):
@@ -608,31 +659,171 @@ def open_block_store_dataset(directory, verify=True):
     return _dataset_from_sidecar(store.load_sidecar(), store)
 
 
+def _try_open_matching(cfg, directory, filename, warn_mismatch=True):
+    """Open the store at `directory` iff its manifest matches this
+    (source, binning, block geometry) signature; None otherwise."""
+    if not os.path.exists(os.path.join(directory, MANIFEST_NAME)):
+        return None
+    try:
+        cand = BlockStore.open(directory, verify=cfg.ooc_verify)
+    except BlockStoreError as e:
+        Log.warning("Ignoring unusable block store: %s", e)
+        return None
+    if (cand.manifest.get("source") == source_signature(filename)
+            and cand.manifest.get("binning") == _binning_signature(cfg)
+            and cand.block_rows == effective_block_rows(cfg)):
+        Log.info("Reusing block store %s (%d blocks)", directory,
+                 cand.num_blocks)
+        return cand
+    if warn_mismatch:
+        Log.warning("Block store %s was built from a different "
+                    "(source, binning, block_rows) signature; "
+                    "rebuilding", directory)
+    return None
+
+
 def load_or_build_block_store(loader, filename):
     """DatasetLoader's out-of-core entry: open the store next to the
     data file when its manifest matches this (source, binning, block
     geometry) signature; stream-rebuild otherwise."""
     cfg = loader.config
     directory = cfg.ooc_dir or (str(filename) + ".blocks")
-    want_src = source_signature(filename)
-    want_bin = _binning_signature(cfg)
-    store = None
-    if os.path.exists(os.path.join(directory, MANIFEST_NAME)):
-        try:
-            cand = BlockStore.open(directory, verify=cfg.ooc_verify)
-            if (cand.manifest.get("source") == want_src
-                    and cand.manifest.get("binning") == want_bin
-                    and cand.block_rows == effective_block_rows(cfg)):
-                store = cand
-                Log.info("Reusing block store %s (%d blocks)", directory,
-                         store.num_blocks)
-            else:
-                Log.warning("Block store %s was built from a different "
-                            "(source, binning, block_rows) signature; "
-                            "rebuilding", directory)
-        except BlockStoreError as e:
-            Log.warning("Ignoring unusable block store: %s", e)
+    store = _try_open_matching(cfg, directory, filename)
     if store is None:
         build_block_store_from_file(loader, filename, directory)
         store = BlockStore.open(directory, verify=cfg.ooc_verify)
     return _dataset_from_sidecar(store.load_sidecar(), store)
+
+
+# --------------------------------------------------- shared-store gang
+
+class _OffsetBinsView:
+    """Local-row-indexed traversal view of a gang rank: local row r is
+    global row r + row_lo of the shared store."""
+
+    def __init__(self, store, row_lo, num_rows):
+        self._view = _BlockBinsView(store)
+        self._off = int(row_lo)
+        self.shape = (store.num_stored, int(num_rows))
+
+    def __getitem__(self, key):
+        feat, rows = key
+        return self._view[feat, np.asarray(rows) + self._off]
+
+
+class OutOfCoreGangView(OutOfCoreDataset):
+    """One rank's view of a SHARED block store: the full store handle
+    plus this rank's contiguous owned block range (the jax-free
+    ownership rule, parallel/machines.py partition_blocks). Rows are
+    LOCAL (metadata sliced to the owned rows, num_data = owned rows) so
+    the GBDT layer's row-sharded multi-host path — local scores,
+    global snapshot gather/re-slice by rank-ordered counts — applies
+    unchanged; only the gang learner (data/ooc_parallel.py) knows the
+    bins behind those rows live in a store every rank shares."""
+
+    def __init__(self):
+        super().__init__()
+        self.gang_rank = 0
+        self.gang_world = 1
+        self.block_lo = 0
+        self.block_hi = 0
+        self.row_lo = 0
+        self.row_hi = 0
+        self.global_num_data = 0
+
+    @property
+    def num_data(self):
+        return self.row_hi - self.row_lo
+
+    def traversal_bins(self):
+        return _OffsetBinsView(self.block_store, self.row_lo,
+                               self.num_data)
+
+
+def gang_view_of(ds, rank, num_machines):
+    """Slice a full-store OutOfCoreDataset into one rank's gang view.
+    The world size runs through the `stale_ownership` fault hook: an
+    armed rank derives its range from a stale (one-larger) world, and
+    the cross-rank tiling check below is what must catch it."""
+    from ..parallel.machines import partition_blocks
+    from ..utils import faults
+    store = ds.block_store
+    world = faults.stale_ownership_world(num_machines)
+    blo, bhi = partition_blocks(store.num_blocks, world, int(rank))
+    row_lo = (store.row_start_of(blo) if blo < store.num_blocks
+              else store.num_rows)
+    row_hi = (store.row_start_of(bhi) if bhi < store.num_blocks
+              else store.num_rows)
+    view = OutOfCoreGangView()
+    view.block_store = store
+    view.bin_mappers = ds.bin_mappers
+    view.used_feature_map = ds.used_feature_map
+    view.real_feature_idx = ds.real_feature_idx
+    view.feature_names = list(ds.feature_names)
+    view.num_total_features = ds.num_total_features
+    view.label_idx = ds.label_idx
+    view.metadata = ds.metadata.subset(np.arange(row_lo, row_hi))
+    view.gang_rank = int(rank)
+    view.gang_world = int(num_machines)
+    view.block_lo, view.block_hi = int(blo), int(bhi)
+    view.row_lo, view.row_hi = int(row_lo), int(row_hi)
+    view.global_num_data = int(store.num_rows)
+    return view
+
+
+def _check_gang_tiling(view, num_blocks, num_machines):
+    """COLLECTIVE: every rank gathers every rank's claimed block range
+    and independently checks they tile the store exactly — the guard
+    the `stale_ownership` fault exists to prove. Failing ranks raise a
+    named BlockStoreError before any histogram is built."""
+    import jax
+    from jax.experimental import multihost_utils
+    from ..parallel.heartbeat import collective_guard
+    from ..parallel.machines import check_block_tiling
+    if jax.process_count() != num_machines:
+        Log.fatal("num_machines=%d but %d jax processes are running; "
+                  "block ownership would not tile the store",
+                  num_machines, jax.process_count())
+    mine = np.asarray([view.block_lo, view.block_hi], dtype=np.int64)
+    with collective_guard("ooc:ownership_gather"):
+        ranges = np.asarray(
+            multihost_utils.process_allgather(mine)).reshape(-1, 2)
+    try:
+        check_block_tiling([tuple(r) for r in ranges], num_blocks)
+    except ValueError as e:
+        raise BlockStoreError(str(e))
+
+
+def load_block_store_gang(loader, filename, rank, num_machines):
+    """Gang entry: ONE shared store, built once. Rank 0 reuses or
+    stream-builds it (identical logic to the single-host path); peers
+    poll for a signature-matching manifest instead of each re-binning
+    the file — the manifest is written LAST and atomically, so a
+    matching open is always a complete store. Every rank then takes
+    its contiguous owned-block view and cross-checks the tiling."""
+    cfg = loader.config
+    directory = cfg.ooc_dir or (str(filename) + ".blocks")
+    if int(rank) == 0:
+        ds = load_or_build_block_store(loader, filename)
+    else:
+        store = None
+        deadline = time.monotonic() + float(cfg.ooc_build_wait_s)
+        while store is None:
+            store = _try_open_matching(cfg, directory, filename,
+                                       warn_mismatch=False)
+            if store is None:
+                if time.monotonic() >= deadline:
+                    raise BlockStoreError(
+                        f"rank {rank}: no signature-matching block "
+                        f"store appeared at {directory} within "
+                        f"{cfg.ooc_build_wait_s:.0f}s "
+                        "(ooc_build_wait_s) — did rank 0's build fail?")
+                time.sleep(0.5)
+        ds = _dataset_from_sidecar(store.load_sidecar(), store)
+    view = gang_view_of(ds, rank, num_machines)
+    _check_gang_tiling(view, ds.block_store.num_blocks, num_machines)
+    Log.info("Rank %d/%d owns blocks [%d, %d) = rows [%d, %d) of %d "
+             "(shared store %s)", rank, num_machines, view.block_lo,
+             view.block_hi, view.row_lo, view.row_hi,
+             view.global_num_data, directory)
+    return view

@@ -35,6 +35,7 @@ The fused multi-iteration scan is intentionally ineligible here —
 per-iteration host control is what lets the bin matrix stay on disk.
 """
 
+import os
 import time
 
 import numpy as np
@@ -90,11 +91,38 @@ class OutOfCoreTreeLearner:
                       "dataset maps %d", store.num_stored,
                       self.num_features)
 
+        # contiguous owned block range over the (possibly shared)
+        # store: everything on the gang learner (data/ooc_parallel.py),
+        # re-derived at every init — an elastic restart that changed
+        # the world re-shards ownership here, never re-bins
+        blo, bhi = self._owned_block_range(store)
+        self._blk_lo, self._blk_hi = int(blo), int(bhi)
+        self._restart_attempt = int(
+            os.environ.get("LIGHTGBM_TPU_RESTART_ATTEMPT", "0") or 0)
+        self._reshard_journaled = False
+        if self._restart_attempt > 0:
+            # resume over a store that sat on disk through a kill, with
+            # ownership this rank may have just adopted: re-check the
+            # manifest crc32 of every block it NOW owns before first
+            # use (BlockStoreError names any rotted block)
+            store.reverify(self._blk_lo, self._blk_hi)
+            Log.info("restart attempt %d: re-verified owned blocks "
+                     "[%d, %d) of %s", self._restart_attempt,
+                     self._blk_lo, self._blk_hi, store.directory)
+
         # row geometry: mirror the serial masked builder's CPU padding
         # (rows padded to the scan chunk) so the blockwise Kahan fold
-        # walks the IDENTICAL chunk sequence — the parity contract
+        # walks the IDENTICAL chunk sequence — the parity contract.
+        # Rows are LOCAL (the owned blocks'); the gang dataset view
+        # already slices metadata/num_data to match.
         chunk = int(cfg.device_row_chunk)
         n = self.num_data
+        owned_rows = sum(store.block_rows_of(i)
+                         for i in range(self._blk_lo, self._blk_hi))
+        if owned_rows != n:
+            Log.fatal("owned blocks [%d, %d) hold %d rows but the "
+                      "dataset view claims %d — stale ownership",
+                      self._blk_lo, self._blk_hi, owned_rows, n)
         n_pad = ((n + chunk - 1) // chunk) * chunk if n > chunk else n
         self.n_pad = n_pad
         self.row_chunk = min(chunk, n_pad) if n_pad else chunk
@@ -109,9 +137,10 @@ class OutOfCoreTreeLearner:
         for i in range(n_spans):
             s = i * store.block_rows
             e = min(s + store.block_rows, n_pad)
-            data_rows = store.block_rows_of(i) if i < store.num_blocks \
+            gb = self._blk_lo + i
+            data_rows = store.block_rows_of(gb) if gb < self._blk_hi \
                 else 0
-            spans.append((i if data_rows else None, e - s, data_rows))
+            spans.append((gb if data_rows else None, e - s, data_rows))
         self._spans = spans
         self._prefetcher = BlockPrefetcher(
             store, spans, depth=int(cfg.prefetch_depth),
@@ -150,6 +179,13 @@ class OutOfCoreTreeLearner:
                  "budget %.1f MB)", self.num_data, self.num_features,
                  store.num_blocks, store.block_rows, store.dtype.name,
                  self._prefetcher.resident_bytes() / 1e6)
+
+    def _owned_block_range(self, store):
+        """(lo, hi) block range this learner streams and partitions.
+        Serial: the whole store. The gang learner overrides with its
+        rank's contiguous owned range (parallel/machines.py
+        partition_blocks via MeshTopology.owned_block_range)."""
+        return 0, store.num_blocks
 
     def _cache_hists(self, cfg):
         """Cache-vs-recompute through the SAME rule as the in-RAM
@@ -223,14 +259,22 @@ class OutOfCoreTreeLearner:
             for s, e, blk in self._prefetcher.stream():
                 acc, comp = self._fold(acc, comp, blk, ghc_dev[:, s:e],
                                        rl_dev[s:e], lid)
-            # the collapse wait is a blocking device sync: arm the
-            # watchdog + wait attribution around it like every other
-            # sync point (the guard is a no-op when disarmed/unbound)
-            with collective_guard("ooc:hist_fold"):
-                hist = jax.block_until_ready(
-                    hist_pair_fold_collapse(acc, comp))
+            # serial: collapse the local pair; gang: exchange partial
+            # pairs across ranks first (data/ooc_parallel.py) — either
+            # way the pass wall includes the sync, so overlap_pct keeps
+            # meaning 'share of the pass NOT stalled on IO'
+            hist = self._combine_pair(acc, comp)
         self._prefetcher.note_pass_wall(time.perf_counter() - t0)
         return hist
+
+    def _combine_pair(self, acc, comp):
+        """Local (acc, comp) Kahan pair -> final (F, B, 3) histogram.
+        The collapse wait is a blocking device sync: arm the watchdog +
+        wait attribution around it like every other sync point (the
+        guard is a no-op when disarmed/unbound)."""
+        with collective_guard("ooc:hist_fold"):
+            return jax.block_until_ready(
+                hist_pair_fold_collapse(acc, comp))
 
     def _partition_update(self, rl, best_leaf, right_id, feat, thr, cat):
         """DataPartition::Split, blockwise: the split feature's bin
@@ -239,8 +283,8 @@ class OutOfCoreTreeLearner:
         columns)."""
         store = self.train_set.block_store
         n = self.num_data
-        for i in range(store.num_blocks):
-            s = i * store.block_rows
+        for i in range(self._blk_lo, self._blk_hi):
+            s = (i - self._blk_lo) * store.block_rows
             e = s + store.block_rows_of(i)
             col = store.feature_rows(i, feat).astype(np.int64)
             seg = rl[s:e]
@@ -462,12 +506,41 @@ class OutOfCoreTreeLearner:
             self.metrics.set("prefetch_overlap_pct",
                              stats["prefetch_overlap_pct"])
 
+    def _gang_shape(self):
+        """(world, rank) of this incarnation — (1, 0) for the serial
+        learner; the gang learner overrides."""
+        return 1, 0
+
+    def _journal_reshard_once(self):
+        """One `block_reshard` record per learner incarnation: this
+        rank's owned block range, re-derived from the CURRENT world.
+        Lazy (like the meshed learners' `mesh` record) because the
+        journal opens after learner init. Across an elastic restart
+        the record's shards/block range change while zero `binning`
+        events appear between — the journal-side proof that survivors
+        adopted blocks instead of re-binning."""
+        if self._reshard_journaled:
+            return
+        from ..telemetry import journal as run_journal
+        j = run_journal.current()
+        if j is None:
+            return
+        self._reshard_journaled = True
+        world, rank = self._gang_shape()
+        j.event("block_reshard",
+                blocks=int(self.train_set.block_store.num_blocks),
+                shards=int(world), rank=int(rank),
+                block_lo=int(self._blk_lo), block_hi=int(self._blk_hi),
+                rows=int(self.num_data),
+                attempt=int(self._restart_attempt), learner=self.name)
+
     def journal_fields(self):
         """Extra fields for the booster's per-iteration journal record
         (models/gbdt.py train_one_iter). Deltas are taken against the
         LAST journal record, not the last train_device call — a
         multiclass iteration runs K per-class builds and the one record
         must cover all of them."""
+        self._journal_reshard_once()
         stats = self._prefetcher.stats()
         prev, self._journal_prev = self._journal_prev, stats
         return {

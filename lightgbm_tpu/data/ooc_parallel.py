@@ -1,0 +1,144 @@
+"""Out-of-core GANG learner: data-parallel training over ONE shared
+block store.
+
+The serial out-of-core learner (data/ooc_learner.py) streams every
+block of the store through one process. This learner splits the SAME
+store across W processes by contiguous block ranges — rank r owns
+blocks [lo, hi) under the jax-free ownership rule
+(parallel/machines.py partition_blocks, surfaced through
+MeshTopology.owned_block_range) — so the dataset is binned ONCE
+(rank 0 builds, peers adopt; data/block_store.py load_block_store_gang)
+and each rank's streamed working set shrinks by W.
+
+Per histogram pass each rank folds its owned blocks into a local
+Kahan (acc, comp) carry exactly as the serial learner does — block
+boundaries on the chunk grid, identical per-block arithmetic — then
+the ranks exchange the COMPENSATED PAIRS and every rank folds the 2W
+words in fixed rank order (parallel/mesh.py kahan_fold, the same fold
+pair_allreduce uses), so every rank ends with the identical global
+histogram and the host split loop proceeds in lockstep with no
+further communication until the next pass. The split loop, the
+partition update (owned blocks only, local row offsets) and the tree
+bookkeeping are all inherited unchanged.
+
+Elastic shrink/grow falls out of re-derivation: ownership is computed
+from the CURRENT world at every learner init, so a supervisor restart
+with fewer (or restored) ranks re-partitions block ranges the same
+way PR 10 re-partitions feature ownership — survivors resume from the
+newest shared snapshot plus the already-built store, journaling a
+`block_reshard` event with ZERO `binning` events (no re-bin). A
+shrink to one rank resumes through the serial out-of-core learner
+(config.check_param_conflict coerces num_machines=1 to
+tree_learner=serial), which reads the same store end to end.
+
+Wire model: one pair exchange per streamed histogram pass —
+allgather of 2 f32 words x (F, B, 3) per rank. Root pass always;
+per split, one pass with the cached-parent subtraction, two without
+(CommPlan `hist_reduce`; the split loop itself is replicated, so
+`split_gather`/`leaf_sync` stay zero).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.heartbeat import collective_guard
+from ..parallel.mesh import (COLLECTIVE_KINDS, CommPlan,
+                             allgather_recv_bytes, kahan_fold)
+from ..utils.log import Log
+from .ooc_learner import OutOfCoreTreeLearner
+
+
+class OutOfCoreGangLearner(OutOfCoreTreeLearner):
+    """Shared-block-store data-parallel learner (tree_learner=data +
+    out_of_core=true + num_machines>1). Rows are sharded by owned
+    block range, so the GBDT layer's row-sharded multi-host machinery
+    (local scores, global snapshot gather/re-slice) applies as-is."""
+
+    name = "out_of_core_gang"
+    partitioned_capable = False
+    shard_rows = True
+
+    def init(self, train_set):
+        self.n_proc = int(getattr(train_set, "gang_world", 1))
+        self.rank = int(getattr(train_set, "gang_rank", 0))
+        if getattr(train_set, "block_store", None) is not None and \
+                not hasattr(train_set, "block_lo"):
+            Log.fatal("the gang learner needs a gang dataset view "
+                      "(data/block_store.py gang_view_of); got a "
+                      "whole-store dataset — was the data loaded with "
+                      "num_machines=1?")
+        if self.n_proc > 1 and jax.process_count() != self.n_proc:
+            Log.fatal("gang world is %d but %d jax processes are "
+                      "running", self.n_proc, jax.process_count())
+        self.global_num_data = int(getattr(train_set, "global_num_data",
+                                           0)) or train_set.num_data
+        super().init(train_set)
+        # wire plan: one compensated-pair allgather per streamed pass
+        pair_bytes = 2 * self.num_features * self.max_bin * 3 * 4
+        per_pass = allgather_recv_bytes(pair_bytes, self.n_proc)
+        self._comm_plan = CommPlan().add(
+            "hist_reduce", root=per_pass,
+            per_split=per_pass * (1 if self._cache_ok else 2))
+        self._journal_prev_comm = None
+        Log.info("gang rank %d/%d: blocks [%d, %d), %d local rows of "
+                 "%d global", self.rank, self.n_proc, self._blk_lo,
+                 self._blk_hi, self.num_data, self.global_num_data)
+
+    # ------------------------------------------------------- ownership
+    def _owned_block_range(self, store):
+        # the dataset view derived (and cross-rank tiling-checked) the
+        # range at load; re-deriving here must agree by construction —
+        # both run partition_blocks on (num_blocks, world, rank)
+        ts = self.train_set
+        return int(ts.block_lo), int(ts.block_hi)
+
+    def _gang_shape(self):
+        return self.n_proc, self.rank
+
+    # -------------------------------------------------------- exchange
+    def _combine_pair(self, acc, comp):
+        """Gang histogram exchange: allgather every rank's local
+        (acc, -comp) pair and fold the 2W words in fixed rank order —
+        mirroring pair_allreduce's [hi_0..hi_W, lo_0..lo_W] fold, so
+        the result is identical on every rank and mutually
+        bit-comparable with the meshed learners' exchanges."""
+        if self.n_proc <= 1:
+            return super()._combine_pair(acc, comp)
+        with collective_guard("ooc:hist_exchange"):
+            pair = jnp.stack([acc, -comp])           # (2, F, B, 3)
+            stacked = jnp.asarray(np.asarray(
+                _process_allgather(pair)))           # (W, 2, F, B, 3)
+            words = jnp.concatenate(
+                [stacked[:, 0], stacked[:, 1]], axis=0)
+            return jax.block_until_ready(kahan_fold(words))
+
+    # ------------------------------------------ collective-byte ledger
+    def account_tree_collectives(self, n_splits):
+        """Advance collective_bytes_{kind} by this tree's realized wire
+        bytes (models/gbdt.py calls this after the leaf-count sync)."""
+        m = getattr(self, "metrics", None)
+        if m is not None and self._comm_plan is not None:
+            self._comm_plan.account(m, max(int(n_splits), 0))
+
+    def journal_fields(self):
+        fields = super().journal_fields()
+        m = getattr(self, "metrics", None)
+        if m is None:
+            return fields
+        cur = {k: int(m.counter(f"collective_bytes_{k}").value)
+               for k in COLLECTIVE_KINDS}
+        prev = self._journal_prev_comm or {k: 0 for k in cur}
+        self._journal_prev_comm = cur
+        fields["collective_bytes"] = {k: cur[k] - prev.get(k, 0)
+                                      for k in cur}
+        return fields
+
+
+def _process_allgather(x):
+    """Host-driven cross-process allgather (the split loop lives on
+    host, so the exchange cannot ride inside a meshed program the way
+    pair_allreduce does)."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(np.asarray(x))

@@ -25,6 +25,8 @@ import time
 
 import numpy as np
 
+from ..utils import faults
+
 
 class BlockPrefetcher:
     """Streams a fixed span plan (the learner's padded block geometry)
@@ -140,6 +142,9 @@ class BlockPrefetcher:
                     # pinned block), part of producer busy time.
                     staged = self._stage(np.array(buf[:, :span_rows]))
                     self._free.put(buf)   # detached: safe to recycle
+                    # preemption landing while staging is in flight —
+                    # the chaos rung's kill window (utils/faults.py)
+                    faults.rank_crash_in_prefetch_if_reached()
                     self.read_s += time.perf_counter() - t0
                     self.bytes_read += rows * self.store.num_stored \
                         * self.store.dtype.itemsize

@@ -631,14 +631,17 @@ class DatasetLoader:
                 Log.fatal("out_of_core does not support continued "
                           "training (init scores need resident raw "
                           "values)")
-            if num_machines > 1:
-                Log.fatal("out_of_core is single-host; per-shard block "
-                          "stores arrive with the pod-scale mesh "
-                          "refactor")
             if cfg.max_bad_rows > 0:
                 Log.warning("max_bad_rows=%d is not applied on the "
                             "out-of-core streaming load path: malformed "
                             "rows still abort the load", cfg.max_bad_rows)
+            if num_machines > 1:
+                # gang training over ONE shared store: rank 0 builds,
+                # peers adopt their owned block ranges — no per-rank
+                # re-binning (data/block_store.py, docs/Out-of-Core.md)
+                from ..data.block_store import load_block_store_gang
+                return load_block_store_gang(self, filename, rank,
+                                             num_machines)
             from ..data.block_store import load_or_build_block_store
             return load_or_build_block_store(self, filename)
         bin_path = str(filename) + ".bin"

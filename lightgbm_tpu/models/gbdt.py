@@ -15,6 +15,7 @@ window, identical between the fused scan and the per-iteration loop,
 and exact-count like the reference's.
 """
 
+import contextlib
 import functools
 import os
 
@@ -1026,8 +1027,17 @@ class GBDT:
         from ..telemetry.ledger import LEDGER
         hits_before = compile_cache_hits()
         # the compile ledger attributes this lowering to its shape
-        # bucket — the fused scan length is what keys recompiles
-        with LEDGER.label(f"fused_scan_{num_iters}it"):
+        # bucket — the fused scan length is what keys recompiles.
+        # 1-core/1-device runners deadlock embedded host callbacks
+        # (ops/histogram.py host_callbacks_hazardous; our entry points
+        # clear the hazard by forcing a second virtual device, see
+        # utils/hostenv) — trace on the segment kernel as a last
+        # resort so library users there terminate instead of hanging
+        from ..ops import histogram as hist_ops
+        guard = (hist_ops.callbacks_disabled
+                 if hist_ops.host_callbacks_hazardous()
+                 else contextlib.nullcontext)
+        with LEDGER.label(f"fused_scan_{num_iters}it"), guard():
             compiled = jax.jit(fused).lower(score, fmasks, iters,
                                             data).compile()
         # whether the persistent compile cache served this lowering —

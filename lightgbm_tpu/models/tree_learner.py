@@ -43,7 +43,8 @@ import numpy as np
 import contextlib
 
 from ..ops.histogram import (callbacks_disabled, compacted_histograms,
-                             frontier_histograms, set_hist_mode)
+                             frontier_histograms, host_callbacks_hazardous,
+                             set_hist_mode)
 from ..ops.ordered_hist import canonical_row_chunks
 from ..ops.pallas_hist import masked_histograms, HIST_CHUNK
 from ..ops.split import SplitParams, find_best_split, K_MIN_SCORE
@@ -843,8 +844,17 @@ class SerialTreeLearner:
         hess = self._place_rows(hess)
         inbag = self._place_rows(inbag)
         fmask = self._place_rep(self._sample_features())
-        return self._build(self._bins, grad, hess, inbag, fmask,
-                           self._num_bin_pf, self._is_cat)
+        # 1-core, 1-device runners deadlock the bincount callbacks on
+        # this async-dispatched program (ops/histogram.py
+        # host_callbacks_hazardous) — trace with callbacks disabled so
+        # the builder resolves the segment kernel there. The guard only
+        # matters on the first trace per shape bucket; the hazard is
+        # process-stable so later cache hits see the same program.
+        guard = (callbacks_disabled if host_callbacks_hazardous()
+                 else contextlib.nullcontext)
+        with guard():
+            return self._build(self._bins, grad, hess, inbag, fmask,
+                               self._num_bin_pf, self._is_cat)
 
     def train(self, grad, hess, inbag=None):
         """Grow one tree. grad/hess: (N,) device or host float32.
@@ -902,12 +912,19 @@ class SerialTreeLearner:
 def create_tree_learner(learner_type, config):
     """Factory (src/treelearner/tree_learner.cpp:8-19). out_of_core=true
     swaps the serial learner for the block-store streaming learner
-    (lightgbm_tpu/data/ooc_learner.py, docs/Out-of-Core.md)."""
+    (lightgbm_tpu/data/ooc_learner.py, docs/Out-of-Core.md); with
+    tree_learner=data and num_machines>1 it becomes the gang learner
+    over one shared store (lightgbm_tpu/data/ooc_parallel.py)."""
     if getattr(config, "out_of_core", False):
+        if learner_type == "data" and int(getattr(config, "num_machines",
+                                                  1)) > 1:
+            from ..data.ooc_parallel import OutOfCoreGangLearner
+            return OutOfCoreGangLearner(config)
         if learner_type != "serial":
-            Log.fatal("out_of_core=true requires tree_learner=serial "
-                      "(got %s); per-shard block stores arrive with the "
-                      "pod-scale mesh refactor", learner_type)
+            Log.fatal("out_of_core=true supports tree_learner=serial or "
+                      "tree_learner=data with num_machines>1 (got %s); "
+                      "feature/voting-parallel need per-shard feature "
+                      "stores", learner_type)
         from ..data.ooc_learner import OutOfCoreTreeLearner
         return OutOfCoreTreeLearner(config)
     if learner_type == "serial":

@@ -168,6 +168,36 @@ def callbacks_disabled():
         _NO_CALLBACKS.depth = depth
 
 
+def single_worker_host():
+    """True when this process is pinned to a single CPU (checked per
+    call so tests can flip it with sched_setaffinity)."""
+    try:
+        n = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux fallback
+        n = os.cpu_count() or 1
+    return n <= 1
+
+
+def host_callbacks_hazardous():
+    """Whether an async-dispatched jit program embedding pure_callback
+    can deadlock this process's XLA CPU client. Observed on 1-core
+    runners with a single (non-virtualized) CPU device: the client's
+    lone worker executes the builder program while the callback's
+    operand delivery waits for that same thread — the compacted
+    learner's per-iteration path wedges at n > HIST_CHUNK (where
+    hist_compaction auto-enables the frontier/compacted callbacks; the
+    PR 14 cliff). Forcing >= 2 virtual CPU devices
+    (--xla_force_host_platform_device_count, what the test harness and
+    bench children do) gives the callback a worker and clears it, as
+    does the AOT-compiled fused block (models/gbdt.py _get_fused_fn),
+    so the hazard is exactly {1 CPU} x {1 local device} x traced-jit
+    dispatch. The serial learner's train_device consults this and
+    traces its builder under callbacks_disabled (segment kernel:
+    bit-identical per the pinned segment==bincount parity, slower, but
+    today that configuration hangs forever)."""
+    return single_worker_host() and jax.local_device_count() == 1
+
+
 def chunk_mode():
     """Resolve the XLA/host chunk-kernel formulation:
     "bincount" | "segment" | "einsum"."""

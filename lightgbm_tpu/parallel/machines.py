@@ -99,6 +99,50 @@ def partition_features(num_features, num_shards, shard):
     return lo, lo + f_loc
 
 
+def partition_blocks(num_blocks, num_shards, shard):
+    """Contiguous owned BLOCK range of one rank over a shared block
+    store (data/block_store.py): rank r owns
+    [r*base + min(r, rem), ...) where base = num_blocks // num_shards
+    and the first `rem = num_blocks % num_shards` ranks carry one extra
+    block. Unlike `partition_features` there is no padding — blocks are
+    real on-disk data units, so the ranges tile [0, num_blocks)
+    exactly and every block has exactly one owner.
+
+    jax-free on purpose: the supervisor and the elastic tests can state
+    how a shrink/grow re-shards block ownership without touching the
+    accelerator runtime, and the gang learner (data/ooc_parallel.py)
+    derives the SAME range, so the two views can never disagree."""
+    num_shards = max(int(num_shards), 1)
+    num_blocks = int(num_blocks)
+    shard = int(shard)
+    base, rem = divmod(num_blocks, num_shards)
+    lo = shard * base + min(shard, rem)
+    hi = lo + base + (1 if shard < rem else 0)
+    return lo, hi
+
+
+def check_block_tiling(ranges, num_blocks):
+    """Validate that per-rank (lo, hi) block ranges tile [0, num_blocks)
+    exactly, in rank order, with no gap or overlap. A violation means a
+    rank is operating on a STALE ownership view (it derived its range
+    from a different world size than its peers — the failure mode the
+    `stale_ownership` fault injection provokes); training on it would
+    double-count or drop blocks, so this is a hard error."""
+    expect = 0
+    for rank, (lo, hi) in enumerate(ranges):
+        if int(lo) != expect or int(hi) < int(lo):
+            raise ValueError(
+                f"stale block-ownership lease: rank {rank} claims blocks "
+                f"[{lo}, {hi}) but the previous ranks end at {expect} — "
+                "ranks disagree on the world size; refusing to train")
+        expect = int(hi)
+    if expect != int(num_blocks):
+        raise ValueError(
+            f"stale block-ownership lease: ranks cover {expect} of "
+            f"{num_blocks} blocks — ranks disagree on the world size; "
+            "refusing to train")
+
+
 def _local_addresses():
     names = {"localhost", "127.0.0.1", socket.gethostname()}
     try:

@@ -348,6 +348,17 @@ class MeshTopology:
         from .machines import partition_features
         return partition_features(f_pad, self.n_shards, shard)
 
+    def owned_block_range(self, shard, num_blocks):
+        """(lo, hi) BLOCK range rank `shard` owns over a shared
+        out-of-core block store — the shared jax-free ownership rule
+        (parallel/machines.py partition_blocks). Like feature ownership
+        above, this is re-derived from the CURRENT world at every
+        learner init, which is what makes an elastic shrink/grow
+        re-shard blocks (journaled as a `block_reshard` event) instead
+        of forcing a re-bin."""
+        from .machines import partition_blocks
+        return partition_blocks(num_blocks, self.n_proc, shard)
+
     def exchange_groups(self, f_loc):
         """Largest group count <= comm_groups dividing the owned block
         (group boundaries must tile f_loc exactly)."""

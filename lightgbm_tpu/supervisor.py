@@ -115,6 +115,24 @@ def restart_barrier(shared_dir, attempt, my_rank, member_ranks, wait_s,
     return survivors
 
 
+def returned_ranks(shared_dir, attempt, original_members, current_members):
+    """Grow-back scan: original ranks that were pruned in an earlier
+    shrink but whose supervisor posted a marker for THIS attempt — the
+    machine came back (operator restarted its supervisor) and is
+    waiting at the same barrier. Survivor re-admission costs nothing
+    extra: the barrier directory is already shared state, and ownership
+    (rows, feature shards, out-of-core block ranges) re-derives from
+    whatever world count the relaunch passes down."""
+    current = set(current_members)
+    returned = []
+    for r in original_members:
+        if r in current:
+            continue
+        if os.path.exists(_marker_path(shared_dir, attempt, r)):
+            returned.append(int(r))
+    return sorted(returned)
+
+
 def _shift_ports(machines, attempt):
     """Fresh ports per attempt: the previous incarnation's coordinator
     socket may linger in TIME_WAIT on the same host."""
@@ -170,8 +188,11 @@ class Supervisor:
         else:
             self.rank = 0
         # identity is the ORIGINAL rank; membership shrinks across
-        # restarts but original ids keep the barrier unambiguous
+        # restarts but original ids keep the barrier unambiguous.
+        # original_members stays fixed so a pruned rank whose machine
+        # comes back can be re-admitted at a later barrier (grow-back)
         self.members = list(range(max(len(self.machines), 1)))
+        self.original_members = list(self.members)
         # a reused snapshot dir may hold THIS rank's restart markers
         # from a previous incarnation; left in place they would count a
         # later-dead rank as a barrier survivor and block the shrunken-
@@ -262,23 +283,35 @@ class Supervisor:
                 return code
             attempt += 1
             prev_world = len(self.members)
-            if len(self.members) > 1:
+            if len(self.original_members) > 1:
                 survivors = restart_barrier(
                     self.shared_dir, attempt, self.rank, self.members,
                     self.barrier_wait_s, exit_code=code)
-                if survivors != self.members:
-                    self.members = survivors
+                # grow-back: a previously pruned rank whose supervisor
+                # posted a marker for THIS attempt rejoins — ownership
+                # widens back at relaunch exactly the way it shrank
+                returned = returned_ranks(self.shared_dir, attempt,
+                                          self.original_members, survivors)
+                if returned:
+                    Log.info("restart barrier (attempt %d): rank(s) %s "
+                             "returned — growing the world back to %d "
+                             "rank(s)", attempt, returned,
+                             len(survivors) + len(returned))
+                members = sorted(set(survivors) | set(returned))
+                if members != self.members:
+                    self.members = members
                 machines = _shift_ports(
-                    [self.machines[r] for r in survivors], attempt)
-                new_rank = survivors.index(self.rank)
+                    [self.machines[r] for r in self.members], attempt)
+                new_rank = self.members.index(self.rank)
                 mlist_override = self._write_shrunk_mlist(machines, attempt)
             shrunk = len(self.members) < prev_world
+            grown = len(self.members) > prev_world
             self._journal_event("restart", attempt=attempt,
                                 exit_code=int(code),
                                 reason=describe_exit(code),
                                 survivors=list(self.members),
                                 new_rank=int(new_rank),
-                                mesh_reshard=bool(shrunk))
+                                mesh_reshard=bool(shrunk or grown))
             Log.info("supervisor: restarting rank %d as rank %d of %d "
                      "(%sresume from newest snapshot under %s)", self.rank,
                      new_rank, max(len(machines), 1),

@@ -110,6 +110,23 @@ SCHEMA = {
              "optional": {"processes": int, "precision": str,
                           "exchange": str, "f_pad": int, "f_loc": int,
                           "learner": str, "source": str}},
+    # one record per out-of-core learner incarnation: this rank's owned
+    # block range over the shared store (data/ooc_learner.py). Across
+    # an elastic shrink/grow the journal shows block ownership
+    # re-sharding (shards/block_lo/block_hi change, attempt advances)
+    # with ZERO `binning` events between — the proof that survivors
+    # adopted blocks instead of re-binning (docs/Out-of-Core.md)
+    "block_reshard": {"required": {"blocks": int, "shards": int},
+                      "optional": {"rank": int, "block_lo": int,
+                                   "block_hi": int, "rows": int,
+                                   "attempt": int, "learner": str,
+                                   "source": str}},
+    # one record per block-store BUILD (the two-round streaming binning
+    # pass, data/block_store.py) — elastic restarts assert none of
+    # these appear after the first incarnation
+    "binning": {"required": {"rows": int, "blocks": int},
+                "optional": {"directory": str, "features": int,
+                             "build_count": int, "source": str}},
     "run_end": {"required": {"iterations": int},
                 "optional": {"train_s": float, "source": str}},
     # per-iteration/block collective latency attribution (`comm_telemetry`

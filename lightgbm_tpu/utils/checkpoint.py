@@ -227,6 +227,12 @@ class CheckpointManager:
         # the LOADER's validation is what the test exercises
         blob = faults.mangle_checkpoint_blob(blob)
         path = self.path_for(iteration)
+        # injection point: preemption MID-WRITE — half the payload lands
+        # in the sibling tmp file and the process dies before the
+        # rename, so the previous checkpoint must survive and the
+        # resume must ignore the tmp debris (the elastic chaos rung)
+        faults.crash_in_checkpoint_write_if_armed(
+            f"{path}.tmp.{os.getpid()}", blob)
         atomic_write_bytes(path, blob)
         Log.debug("Checkpoint saved: %s (%d bytes)", path, len(blob))
         self._rotate()

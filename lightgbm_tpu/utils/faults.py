@@ -52,6 +52,36 @@ models one preemption/straggler event, not a permanently broken rank.
   rank's heartbeat publisher stops writing while training continues —
   models a wedged monitor/filesystem so peers declare it dead.
 
+Elastic out-of-core faults (the preemption surface of the shared
+block-store gang, data/ooc_parallel.py + docs/Out-of-Core.md). Like
+the rank faults above, the one-shot kills are disarmed on a restarted
+attempt — each models one preemption event:
+
+- ``rank_crash_in_prefetch`` (rank index; -1 = every rank):
+  `os._exit(43)` the matching rank from INSIDE the block-prefetch
+  producer thread, right after its first block read of a pass
+  (data/prefetch.py) — a preemption landing while disk/device staging
+  is in flight, the window where a naive design would leave a torn
+  store. The store is read-only during training, so survivors must
+  adopt the dead rank's blocks with zero re-binning.
+- ``crash_in_checkpoint_write`` (count): the next k checkpoint saves
+  write HALF the payload to the sibling tmp file and `os._exit(43)`
+  before the atomic rename (utils/checkpoint.py) — a preemption
+  mid-checkpoint-write at a block boundary. The previous snapshot must
+  survive (rename never happened) and the resume must ignore the tmp
+  debris.
+- ``stale_ownership`` (rank index; -1 = every rank): the matching rank
+  derives its owned block range from a world ONE LARGER than the real
+  one — a stale ownership lease after an elastic re-shard. The gang's
+  cross-rank tiling check (parallel/machines.py check_block_tiling)
+  must refuse to train rather than drop/double-count blocks.
+- ``bitrot_block_on_restart`` (block index): on a RESTARTED attempt
+  only, flip one byte of that block's file on disk before the
+  post-restart re-verification pass — bit-rot landing between
+  attempts. The resuming rank's owned-block crc re-check
+  (data/block_store.py BlockStore.reverify) must fail with a named
+  BlockStoreError instead of training on garbage.
+
 Serving chaos faults (the resilience layer; serving/server.py,
 serving/batcher.py, fleet/registry.py). These are readable through a
 per-server overrides dict (`serving_chaos`) so a multi-replica chaos
@@ -308,6 +338,85 @@ def rank_hang_if_reached(first_iteration, num_iterations=1):
                     k, current_rank())
         while True:
             time.sleep(3600)
+
+
+def _rank_flag_fires(name, rank=None):
+    """Shared semantics of rank-valued faults: value == rank fires that
+    rank, -1 fires every rank. None when unarmed/unparsable."""
+    value = _active.get(name)
+    if value is None:
+        return False
+    try:
+        value = int(value)
+    except (TypeError, ValueError):
+        return False
+    if rank is None:
+        rank = current_rank()
+    return value in (-1, int(rank))
+
+
+def rank_crash_in_prefetch_if_reached():
+    """`rank_crash_in_prefetch`: hard-kill the matching rank from the
+    prefetch producer thread (data/prefetch.py calls this right after
+    a block read lands in the staging ring). `os._exit` from a daemon
+    thread takes the whole process down with no cleanup — exactly a
+    preemption mid-staging. One-shot: disarmed on a restarted
+    attempt."""
+    if _is_restarted_attempt():
+        return
+    if _rank_flag_fires("rank_crash_in_prefetch"):
+        os._exit(HARD_CRASH_EXIT_CODE)
+
+
+def crash_in_checkpoint_write_if_armed(tmp_path, blob):
+    """`crash_in_checkpoint_write`: write half of `blob` to `tmp_path`
+    and hard-exit — a preemption mid-checkpoint-write. The caller's
+    atomic rename never runs, so the previous checkpoint survives and
+    the half-written tmp file is the debris a resume must ignore.
+    Disarmed on a restarted attempt (one preemption event)."""
+    if _is_restarted_attempt():
+        return
+    if not consume("crash_in_checkpoint_write"):
+        return
+    with open(tmp_path, "wb") as f:
+        f.write(blob[:max(1, len(blob) // 2)])
+        f.flush()
+        os.fsync(f.fileno())
+    os._exit(HARD_CRASH_EXIT_CODE)
+
+
+def stale_ownership_world(num_shards):
+    """`stale_ownership`: the world size the matching rank should use
+    when deriving its owned block range — one larger than the real one,
+    modelling a lease from before an elastic re-shard. Identity for
+    unmatched ranks / unarmed. NOT disarmed on restart: the stale view
+    is a property of the lease, not a one-shot event; the tiling check
+    must catch it on every attempt it survives."""
+    if _rank_flag_fires("stale_ownership"):
+        return int(num_shards) + 1
+    return int(num_shards)
+
+
+def bitrot_block_if_armed(block_path_of, lo, hi):
+    """`bitrot_block_on_restart`: on a restarted attempt, flip one byte
+    of the armed block's file (value = block index) when it falls in
+    this rank's owned range [lo, hi). `block_path_of` maps a block
+    index to its file path. Consumed once; fires only on restart — the
+    rot happened BETWEEN attempts, so the re-verification pass
+    (BlockStore.reverify) is the layer that must catch it."""
+    if not _is_restarted_attempt():
+        return
+    target = _active.get("bitrot_block_on_restart")
+    if not isinstance(target, int) or not (lo <= target < hi):
+        return
+    if not consume("bitrot_block_on_restart"):
+        return
+    path = block_path_of(target)
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([byte[0] ^ 0xFF]))
 
 
 def heartbeat_suppressed(rank=None):

@@ -1,0 +1,40 @@
+"""Pre-backend host environment shims (jax-free on purpose).
+
+This module must stay importable and callable BEFORE the XLA CPU
+client exists: it edits XLA_FLAGS, which the client reads once at
+instantiation. jax imports are fine (client creation is lazy), jax
+*use* is not.
+"""
+
+import os
+
+
+def ensure_callback_worker_devices(min_devices=2):
+    """On single-CPU hosts, force at least `min_devices` virtual XLA
+    host devices so pure_callback always has a worker thread to run on.
+
+    A CPU client built with one device on a one-core machine deadlocks
+    host callbacks embedded in async-dispatched programs: the lone
+    worker executes the program while the callback's operand delivery
+    waits for that same thread (ops/histogram.py
+    host_callbacks_hazardous — the PR 14 compacted-learner cliff at
+    n > HIST_CHUNK). Two virtual devices cost nothing measurable on the
+    rungs this repo gates and clear the hazard entirely.
+
+    Respects an explicit xla_force_host_platform_device_count anywhere
+    in XLA_FLAGS (tests pin 8, distributed harnesses pin 2). Returns
+    True when the flag was added.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return False
+    try:
+        n_cpus = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux fallback
+        n_cpus = os.cpu_count() or 1
+    if n_cpus > 1:
+        return False
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={min_devices}"
+    ).strip()
+    return True

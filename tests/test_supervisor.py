@@ -286,6 +286,7 @@ def _gang(tmp_path, tag, module, fault_specs, extra=(), timeout=300):
     return results
 
 
+@pytest.mark.slow
 def test_rank_crash_supervisor_restart_model_parity(tmp_path):
     """THE acceptance path: rank 1 is os._exit-killed at iteration 3;
     the surviving rank detects it within heartbeat_timeout_s (no
@@ -336,6 +337,7 @@ def test_rank_crash_supervisor_restart_model_parity(tmp_path):
     assert "resume" in events and "run_end" in events
 
 
+@pytest.mark.slow
 def test_watchdog_abort_names_hung_rank_iteration_collective(tmp_path):
     """A STRAGGLER (not a death): rank 1 sleeps forever at iteration 3
     while still heartbeating, so only the collective watchdog can save
@@ -375,6 +377,7 @@ def test_watchdog_abort_names_hung_rank_iteration_collective(tmp_path):
     assert abort["iteration"] == 3 and abort["collective"]
 
 
+@pytest.mark.slow
 def test_shrunken_world_restart_smoke(tmp_path):
     """Rank 1 dies and NEVER comes back (no supervisor on its machine):
     rank 0's supervisor times out waiting at the restart barrier,

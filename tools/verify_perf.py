@@ -343,6 +343,85 @@ def check_dist():
     return ok and good
 
 
+def check_elastic():
+    """Elastic out-of-core guard (`make verify-elastic`; the bench's
+    elastic_probe in gate form): over ONE shared block store, (1) the
+    binning pass must run EXACTLY ONCE across the cold -> snapshot
+    resume -> 2-process gang sequence (the manifest's lifetime
+    build_count ledger — the zero-re-bin contract), (2) the
+    snapshot-resume leg must undercut the cold re-bin restart by
+    VERIFY_ELASTIC_MAX_FRAC (default 0.9 — it skips the binning pass
+    and half the iteration budget, so anything close to parity means
+    the store adopt or the resume is broken), (3) the gang leg must
+    report BOTH comm_overlap_pct and prefetch_overlap_pct from the
+    same run's journal, and (4) ooc_dist.rows_s must stay within
+    VERIFY_ELASTIC_TOL (default 0.5) of the committed
+    elastic_gang_rows_s baseline."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import bench
+    res = bench.elastic_probe(
+        timeout_s=int(os.environ.get("VERIFY_ELASTIC_TIMEOUT", "480")))
+    if "error" in res:
+        print(f"verify-elastic: probe failed: {res['error']}")
+        return False
+    ok = True
+    gang = res["ooc_dist"]
+    print(f"verify-elastic: {res['rows']} rows x {res['iters']} iters; "
+          f"cold re-bin {res['cold_rebin_s']:.2f}s, snapshot resume "
+          f"{res['resume_s']:.2f}s ({res['resume_speedup']:.2f}x), "
+          f"gang {gang['rows_s']:.0f} rows/s")
+    counts = (res["build_count_cold"], res["build_count_resume"],
+              gang["build_count"])
+    if counts != (1, 1, 1):
+        print(f"verify-elastic: manifest build_count across "
+              f"cold/resume/gang = {counts} -> DATA WAS RE-BINNED")
+        ok = False
+    else:
+        print("verify-elastic: build_count 1 across cold -> resume -> "
+              "gang (one binning pass, two adoptions) -> OK")
+    frac = float(os.environ.get("VERIFY_ELASTIC_MAX_FRAC", "0.9"))
+    limit = frac * res["cold_rebin_s"]
+    if res["resume_s"] > limit:
+        print(f"verify-elastic: resume {res['resume_s']:.2f}s > "
+              f"{frac:.2f}x cold re-bin {res['cold_rebin_s']:.2f}s "
+              "-> RESUME NOT CHEAPER THAN RE-BINNING")
+        ok = False
+    else:
+        print(f"verify-elastic: resume {res['resume_s']:.2f}s vs cold "
+              f"re-bin {res['cold_rebin_s']:.2f}s (limit {limit:.2f}s) "
+              "-> OK")
+    if res["resume_trees"] != res["iters"]:
+        print(f"verify-elastic: resumed model has {res['resume_trees']} "
+              f"tree(s), expected {res['iters']} -> RESUME LOST WORK")
+        ok = False
+    co, po = gang["comm_overlap_pct"], gang["prefetch_overlap_pct"]
+    if co is None or po is None:
+        print(f"verify-elastic: gang journal missing overlap "
+              f"attribution (comm={co}, prefetch={po}) -> "
+              "TELEMETRY INCOMPLETE")
+        ok = False
+    else:
+        print(f"verify-elastic: gang run reports comm overlap "
+              f"{co:.1f}% AND prefetch overlap {po:.1f}% -> OK")
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    base_rows_s = base.get("elastic_gang_rows_s")
+    if not base_rows_s:
+        print("verify-elastic: baseline has no elastic_gang_rows_s — "
+              "regression gate skipped (bump BENCH_BASELINE.json to "
+              "arm)")
+        return ok
+    tol = float(os.environ.get("VERIFY_ELASTIC_TOL", "0.5"))
+    floor = base_rows_s * (1.0 - tol)
+    good = gang["rows_s"] >= floor
+    print(f"verify-elastic: gang {gang['rows_s']:.0f} rows/s vs "
+          f"baseline {base_rows_s:.0f} (floor {floor:.0f}) -> "
+          f"{'OK' if good else 'REGRESSION'}")
+    return ok and good
+
+
 def check_fleet():
     """Fleet/hot-swap acceptance guard (`make verify-fleet`; the
     bench's fleet_probe in gate form): the sustained-QPS CPU serving
@@ -578,6 +657,12 @@ def main():
             print("verify-dist: FAILED")
             return 1
         print("verify-dist: all checks passed")
+        return 0
+    if "--elastic" in sys.argv:
+        if not check_elastic():
+            print("verify-elastic: FAILED")
+            return 1
+        print("verify-elastic: all checks passed")
         return 0
     ok, res = check_speed()
     ok = check_history(res) and ok
