@@ -41,8 +41,8 @@ verify-lint:
 # the default CI aggregate: every verify target, cheapest gate first
 # (a lint violation fails in seconds, before any training run starts)
 verify: verify-lint verify-fault verify-serve verify-obs verify-quality \
-	verify-perf verify-ooc verify-elastic verify-fleet verify-resilience \
-	verify-dist verify-dist-perf
+	verify-linear verify-perf verify-ooc verify-elastic verify-fleet \
+	verify-resilience verify-dist verify-dist-perf
 
 # fault-injection suite: checkpoint/resume determinism, corrupt-snapshot
 # fallback, non-finite guardrails, distributed-init hardening
@@ -111,6 +111,20 @@ verify-quality:
 	  tests/test_quality.py tests/test_drift.py -q -m 'not slow' \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
 
+# linear-leaf suite (docs/Linear-Trees.md): fit quality vs constant
+# leaves, serial==out-of-core byte parity, format_version=2 round-trip
+# + forward-compat rejection, checkpoint crash-resume byte parity,
+# serving exact-path bit parity + bf16 pinned bound, and the hot-swap
+# of a linear challenger over a constant incumbent — then the
+# acceptance guard (bench linear_probe via tools/verify_perf.py
+# --linear: trees-at-equal-AUC / AUC-delta win condition, fused-kernel
+# p99 ratio vs the constant model, zero cold dispatches)
+verify-linear:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+	  tests/test_linear_trees.py -q \
+	  -p no:cacheprovider -p no:xdist -p no:randomly
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --linear
+
 # fleet suite: model registry atomicity/CRC/rollback, hot-swap under
 # concurrent traffic (no mixed-version responses, no 5xx, zero cold
 # dispatches), bf16 serving-precision bound, graceful drain — then the
@@ -170,4 +184,5 @@ clean:
 
 .PHONY: all test-capi verify verify-lint verify-fault verify-dist \
 	verify-dist-perf verify-serve verify-obs verify-perf verify-quality \
-	verify-fleet verify-ooc verify-elastic verify-resilience clean
+	verify-linear verify-fleet verify-ooc verify-elastic \
+	verify-resilience clean

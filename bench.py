@@ -827,6 +827,133 @@ def serving_probe(booster, x):
     return out
 
 
+def linear_probe(timeout_s=420):
+    """Linear-leaf acceptance probe (docs/Linear-Trees.md): on a
+    piece-wise linear synthetic task, train a constant-leaf baseline
+    and a `linear_tree=true` model and report
+
+    - `trees_at_equal_auc_ratio`: the fraction of the baseline's trees
+      the linear model needs to reach the baseline's FINAL valid AUC
+      (the sample-efficiency claim; the gate wants <= 0.6), plus
+      `auc_delta_at_equal_trees` as the alternate win condition;
+    - `serving_p50_ms` / `serving_p99_ms` of a warmed CompiledPredictor
+      for BOTH models and their p99 ratio (the fused traversal+dot
+      kernel must not cost the latency envelope), with the linear
+      predictor's cold-dispatch count (must be 0 after warmup).
+
+    tools/verify_perf.py --linear guards these numbers against
+    BENCH_BASELINE.json."""
+    from lightgbm_tpu.fleet.pipeline import auc_score
+    from lightgbm_tpu.serving import CompiledPredictor
+
+    import lightgbm_tpu as lgb
+
+    out = {}
+    deadline = time.time() + timeout_s
+    try:
+        n = int(os.environ.get("BENCH_LINEAR_ROWS", "20000"))
+        n_valid = max(n // 5, 1000)
+        rounds = int(os.environ.get("BENCH_LINEAR_ROUNDS", "40"))
+        # piece-wise linear ground truth: four regions (the signs of
+        # x0/x1), each with its OWN weight vector over x2..x7 — within
+        # a region the response is a smooth linear surface, which
+        # axis-aligned constant leaves can only staircase
+        rng = np.random.RandomState(13)
+        f = 10
+        x = rng.randn(n + n_valid, f)
+        region = (x[:, 0] > 0).astype(int) * 2 + (x[:, 1] > 0).astype(int)
+        w = rng.randn(4, 6)
+        lin = np.einsum("nf,nf->n", w[region], x[:, 2:8])
+        y = (lin + 0.5 * rng.randn(n + n_valid) > 0).astype(np.float64)
+        xt, yt = x[:n], y[:n]
+        xv, yv = x[n:], y[n:]
+        params = {"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 20, "learning_rate": 0.1,
+                  "verbose": -1}
+        _mark(f"linear probe: training constant baseline ({n} rows, "
+              f"{rounds} trees)")
+        const = lgb.train(dict(params),
+                          lgb.Dataset(xt, yt, params=dict(params)),
+                          num_boost_round=rounds, verbose_eval=False)
+        lin_params = dict(params, linear_tree=True)
+        _mark("linear probe: training linear_tree model")
+        linear = lgb.train(dict(lin_params),
+                           lgb.Dataset(xt, yt, params=dict(lin_params)),
+                           num_boost_round=rounds, verbose_eval=False)
+        target = auc_score(yv, const.gbdt.predict(xv).reshape(-1))
+        lin_final = auc_score(yv, linear.gbdt.predict(xv).reshape(-1))
+        out["const_auc"] = round(float(target), 5)
+        out["linear_auc_at_equal_trees"] = round(float(lin_final), 5)
+        out["auc_delta_at_equal_trees"] = round(float(lin_final
+                                                      - target), 5)
+        out["trees"] = rounds
+        # first prefix of the linear model reaching the baseline's
+        # final AUC (scan, cheap: each predict is one vectorized host
+        # traversal over <= `rounds` trees)
+        need = rounds
+        for i in range(1, rounds + 1):
+            if time.time() > deadline:
+                break
+            a = auc_score(
+                yv, linear.gbdt.predict(xv, num_iteration=i).reshape(-1))
+            if a >= target:
+                need = i
+                break
+        out["trees_to_match_const"] = need
+        out["trees_at_equal_auc_ratio"] = round(need / rounds, 3)
+        # serving latency, warmed single-row p50/p99 for both models on
+        # BOTH ladders. The apples-to-apples kernel comparison (the
+        # gated ratio) is the all-device fused path, where a linear
+        # model is one dispatch exactly like a constant one; the exact
+        # f32 path rides along informationally — its host f64 linear
+        # stage buys bit-parity with the reference at a fixed ~0.2 ms
+        # of host numpy per request (docs/Linear-Trees.md).
+        for name, booster in (("const", const), ("linear", linear)):
+            for prec in ("f32", "bf16"):
+                pred = CompiledPredictor.from_booster(
+                    booster, max_batch_rows=256, serving_precision=prec)
+                row = np.ascontiguousarray(xv[:1], dtype=np.float32)
+                pred.predict(row)  # first touch outside the window
+                lats = []
+                for _ in range(200):
+                    t0 = time.time()
+                    pred.predict(row)
+                    lats.append(time.time() - t0)
+                lats.sort()   # nearest-rank percentiles of 200 samples
+                key = f"{name}_{prec}"
+                out[f"{key}_serving_p50_ms"] = round(lats[99] * 1e3, 4)
+                out[f"{key}_serving_p99_ms"] = round(lats[197] * 1e3, 4)
+                out[f"{key}_cold_dispatches"] = \
+                    pred.stats["cold_dispatches"]
+        out["serving_p99_ratio"] = round(
+            out["linear_bf16_serving_p99_ms"]
+            / max(out["const_bf16_serving_p99_ms"], 1e-9), 3)
+        out["exact_serving_p99_ratio"] = round(
+            out["linear_f32_serving_p99_ms"]
+            / max(out["const_f32_serving_p99_ms"], 1e-9), 3)
+        out["is_linear_served"] = True
+        if not os.environ.get("BENCH_NO_HISTORY"):
+            try:
+                from lightgbm_tpu.telemetry import history
+                history.append_run_summary(
+                    os.environ.get("BENCH_HISTORY_PATH", os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "RUN_HISTORY.jsonl")),
+                    "bench_linear", rows=n, platform="cpu",
+                    linear_trees_at_equal_auc_ratio=out[
+                        "trees_at_equal_auc_ratio"],
+                    linear_auc_delta=out["auc_delta_at_equal_trees"],
+                    linear_serving_p99_ms=out[
+                        "linear_bf16_serving_p99_ms"],
+                    linear_serving_p99_ratio=out["serving_p99_ratio"])
+            except Exception as e:   # never cost the measurement
+                _mark(f"run-history append failed: {e}")
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"linear probe failed: {e}")
+        out["error"] = str(e)[-250:]
+    return out
+
+
 def fleet_probe(timeout_s=300):
     """Fleet/hot-swap acceptance probe (docs/Fleet.md): stand up an
     in-process serving fleet on the CPU rung, drive sustained QPS at
@@ -2119,6 +2246,10 @@ def main():
     if "fleet_probe" in sys.argv:
         # standalone hot-swap/serving probe: `python bench.py fleet_probe`
         print(json.dumps({"serving": fleet_probe()}), flush=True)
+        return
+    if "linear_probe" in sys.argv:
+        # standalone linear-leaf probe: `python bench.py linear_probe`
+        print(json.dumps({"linear": linear_probe()}), flush=True)
         return
     if "router_probe" in sys.argv:
         # standalone front-door chaos probe: `python bench.py router_probe`

@@ -141,8 +141,39 @@ class PrecisionContractRule(Rule):
                 "    return np.asarray(leaves, dtype=np.float64)\n"
             ),
         }
+        # the linear-leaf solver (models/linear_leaves.py) accumulates
+        # per-leaf normal equations in host f64 over the canonical fit
+        # chunk grid — ITS serial==out-of-core bit-parity contract.
+        # Pin that an f32 downgrade of a documented-f64 accumulation in
+        # leaf-solver-shaped code is caught.
+        leaf_solver_bad = {
+            "lightgbm_tpu/models/linsolve.py": (
+                "import numpy as np\n"
+                "def accumulate_normal_eq(xw, g):\n"
+                "    \"\"\"Accumulates the per-leaf normal equations in\n"
+                "    host f64 over canonical fit chunks (the\n"
+                "    linear_leaves.py serial==streamed contract).\"\"\"\n"
+                "    return np.einsum('ni,nj->ij', xw, xw,\n"
+                "                     dtype=np.float32)\n"
+            ),
+        }
+        leaf_solver_good = {
+            "lightgbm_tpu/models/linsolve.py": (
+                "import numpy as np\n"
+                "def accumulate_normal_eq(xw, g):\n"
+                "    \"\"\"Accumulates the per-leaf normal equations in\n"
+                "    host f64 over canonical fit chunks (the\n"
+                "    linear_leaves.py serial==streamed contract).\"\"\"\n"
+                "    return np.einsum('ni,nj->ij', xw, xw,\n"
+                "                     dtype=np.float64)\n"
+            ),
+        }
         return [
             Fixture("f64-trace-f32-doc-float-pair", bad, expect=3),
             Fixture("contract-respected", good, expect=0),
             Fixture("host-f64-legit", good_host_f64, expect=0),
+            Fixture("leaf-solver-f32-downgrade", leaf_solver_bad,
+                    expect=1),
+            Fixture("leaf-solver-f64-contract", leaf_solver_good,
+                    expect=0),
         ]

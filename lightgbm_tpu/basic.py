@@ -506,11 +506,15 @@ class Booster:
         (telemetry/quality.py), reference semantics: `split` = int64
         count of splits using the feature (basic.py:1587-1601),
         `gain` = float64 sum of split gain over those splits (the
-        C API's LGBM_BoosterFeatureImportance gain variant)."""
-        if importance_type not in ("split", "gain"):
+        C API's LGBM_BoosterFeatureImportance gain variant), `coeff` =
+        float64 gain-weighted |coefficient| sums over linear leaves
+        (linear_tree=true models; all-zero otherwise — see
+        docs/Linear-Trees.md)."""
+        from .telemetry.quality import IMPORTANCE_TYPES
+        if importance_type not in IMPORTANCE_TYPES:
             raise LightGBMError(
                 f"Unknown importance type {importance_type!r}: expected "
-                "'split' or 'gain'")
+                f"one of {IMPORTANCE_TYPES}")
         return self.gbdt.feature_importance_values(importance_type)
 
     # ---------------------------------------------------------------- attrs

@@ -204,6 +204,20 @@ class Config:
     histogram_pool_size: float = -1.0
     max_depth: int = -1
     top_k: int = 20
+    # piece-wise linear leaves (Shi et al., arXiv:1802.05640; models/
+    # linear_leaves.py, docs/Linear-Trees.md): after the split search
+    # fixes the structure, fit a ridge model per leaf on the leaf's
+    # root->leaf path features (host f64 normal equations, one stacked
+    # solve across the frontier). Leaves too small or degenerate fall
+    # back to their constant Newton value.
+    linear_tree: bool = False
+    # ridge regularizer added to the feature diagonal of each leaf's
+    # normal matrix (the intercept is not regularized)
+    linear_lambda: float = 0.01
+    # cap on per-leaf model width: the first N distinct path features
+    # in root-first order; must stay <= serving's COEF_PAD (8) so a
+    # linear challenger reuses the warmed serving kernels
+    linear_max_features: int = 8
 
     # --- boosting (config.h:195-216) ---
     metric_freq: int = 1
@@ -580,6 +594,9 @@ class Config:
         check(self.early_stopping_round >= 0, "early_stopping_round should be >= 0")
         check(0.0 <= self.drop_rate <= 1.0, "drop_rate in [0, 1]")
         check(self.num_machines >= 1, "num_machines should be >= 1")
+        check(self.linear_lambda >= 0.0, "linear_lambda should be >= 0")
+        check(self.linear_max_features >= 1,
+              "linear_max_features should be >= 1")
         check(0.0 <= self.max_conflict_rate < 1.0,
               "max_conflict_rate in [0, 1)")
         check(self.num_class >= 1, "num_class should be >= 1")
@@ -661,6 +678,15 @@ class Config:
         if self.tree_learner == "serial":
             self.is_parallel = False
             self.num_machines = 1
+        if self.linear_tree and (self.num_machines > 1
+                                 or self.tree_learner != "serial"):
+            # the leaf refit accumulates normal equations over the FULL
+            # row range on one host; meshed/gang learners would need a
+            # cross-rank reduction of the per-leaf (k+1)^2 matrices
+            Log.fatal("linear_tree=true is single-process "
+                      "(tree_learner=serial, num_machines=1); got "
+                      "tree_learner=%s num_machines=%d"
+                      % (self.tree_learner, self.num_machines))
         if self.tree_learner in ("serial", "feature"):
             self.is_parallel_find_bin = False
         elif self.tree_learner == "data":

@@ -54,6 +54,27 @@ F32 = np.float32
 NEG_INF = np.float32(K_MIN_SCORE)
 
 
+class _OwnedBlockChunks:
+    """Re-iterable (lo, hi, bins, base) view over a learner's owned
+    blocks for the linear leaf fit: one transient read_block memmap per
+    block, rows in LOCAL coordinates (the first owned block starts at
+    0, matching the learner's row_leaf/gradient layout). Iterating
+    twice re-reads the blocks — the fit's two passes each stream the
+    store once, keeping the resident bound unchanged."""
+
+    def __init__(self, learner):
+        self._learner = learner
+
+    def __iter__(self):
+        lrn = self._learner
+        store = lrn.train_set.block_store
+        lo = 0
+        for b in range(lrn._blk_lo, lrn._blk_hi):
+            rows = store.block_rows_of(b)
+            yield lo, lo + rows, store.read_block(b), lo
+            lo += rows
+
+
 class OutOfCoreTreeLearner:
     """Serial-learner-compatible driver whose bin matrix never resides
     in memory. Shares the serial learner's public surface
@@ -242,6 +263,17 @@ class OutOfCoreTreeLearner:
 
     def local_leaf_values(self, out):
         return out["leaf_value"]
+
+    def linear_fit_context(self):
+        """(chunks, bin_value_table, fit_chunk) for the linear leaf fit
+        (models/linear_leaves.py): a re-iterable that streams the owned
+        blocks in ascending local-row order. Block boundaries land on
+        the device_row_chunk grid (enforced at init), so the fit's f64
+        accumulation walks the IDENTICAL chunk sequence as the resident
+        serial learner — the same parity contract as the histogram
+        fold."""
+        return (_OwnedBlockChunks(self), self.train_set.bin_value_table(),
+                int(self.config.device_row_chunk))
 
     # --------------------------------------------------------------- builds
     def _leaf_hist(self, leaf_id, ghc_dev, rl_dev):

@@ -373,6 +373,7 @@ class CoreDataset:
         self.label_idx = 0
         self.metadata = Metadata()
         self._device_bins = None
+        self._bin_value_cache = None
         self.raw_data = None          # optional (N, C) float32 original values
         self.global_num_data = None   # set by per-rank loading (multi-host)
         self.bundle_plan = None       # io/bundling.py BundlePlan or None
@@ -413,6 +414,39 @@ class CoreDataset:
 
     def num_bin_array(self):
         return np.asarray([m.num_bin for m in self.bin_mappers], dtype=np.int32)
+
+    def bin_value_table(self):
+        """(F, max_num_bin) float64 bin representative values
+        (Feature::BinToValue) in VIRTUAL feature space — what linear
+        leaves dot against when scoring in bin space (models/
+        linear_leaves.py, Tree.predict_by_bins). Cached; aligned
+        train/valid sets share bin mappers so their tables match."""
+        if getattr(self, "_bin_value_cache", None) is None:
+            table = np.zeros((self.num_features, self.max_num_bin),
+                             dtype=np.float64)
+            for i, m in enumerate(self.bin_mappers):
+                vals = (m.bin_upper_bound if m.bin_type != CATEGORICAL
+                        else m.bin_2_categorical.astype(np.float64))
+                vals = np.asarray(vals, np.float64).copy()
+                # the last numeric bin's upper bound is +inf (and a
+                # degenerate first bound can be -inf): clamp each
+                # non-finite bound to its nearest finite neighbor so
+                # the linear-leaf dot products stay finite. Bounds are
+                # monotone, so this is the previous (resp. next)
+                # representative.
+                bad = ~np.isfinite(vals)
+                if bad.any():
+                    good = np.nonzero(~bad)[0]
+                    if len(good) == 0:
+                        vals[:] = 0.0
+                    else:
+                        idx = np.clip(
+                            np.searchsorted(good, np.nonzero(bad)[0]),
+                            1, len(good)) - 1
+                        vals[bad] = vals[good[idx]]
+                table[i, :len(vals)] = vals
+            self._bin_value_cache = table
+        return self._bin_value_cache
 
     @property
     def stored_bins_dtype(self):

@@ -35,6 +35,13 @@ from .tree_learner import create_tree_learner
 
 K_MIN_SCORE = -np.inf
 
+# Model text-format version this reader/writer speaks. v1: constant
+# leaves (implicit — no format_version line, byte-identical to every
+# pre-linear release). v2: per-leaf linear coefficient blocks
+# (models/linear_leaves.py, docs/Linear-Trees.md). Loading a HIGHER
+# version is a hard error, never a silent partial parse.
+MODEL_FORMAT_VERSION = 2
+
 
 def f32_safe_thresholds(thr, dt):
     """f32 cast of f64 numeric thresholds rounded toward -inf so
@@ -98,6 +105,12 @@ class LazyTree:
     DART normalization) materializes a real Tree on first touch via the
     learner's batched single-transfer conversion.
     """
+
+    # builder output is always constant-leaf; linear-leaf trees are
+    # materialized eagerly (GBDT._fit_linear_tree), never lazy. A class
+    # attribute keeps `getattr(m, "is_linear", ...)` probes from
+    # forcing a materializing __getattr__ round-trip.
+    is_linear = False
 
     def __init__(self, out, learner, shrink=1.0):
         # row_leaf is (N_pad,) and already consumed by the score updater;
@@ -808,35 +821,57 @@ class GBDT:
             inbag = self._bagging(self.iter, gradients, hessians)
         n = self.num_data
         multi_host = getattr(self.tree_learner, "n_proc", 1) > 1
+        linear = bool(getattr(self.config, "linear_tree", False))
         new_leaves = 0
         for k in range(self.num_class):
             with self.tracer.phase("build"):
                 out = self.tree_learner.train_device(
                     gradients[k], hessians[k], inbag)
             self.metrics.inc("tree_build_dispatches")
-            # enqueue ALL device work for this class before the scalar stop
-            # check: train scores via partition gather (covers in-bag AND
-            # out-of-bag rows: the partition is computed over all rows, the
-            # bag mask only gates the histogram statistics), then valid
-            # scores via device bin-space traversal. A 0-split tree makes
-            # every update a no-op (leaf values are all zero), so checking
-            # afterwards is safe.
-            tree = LazyTree(out, self.tree_learner, shrink=self.shrinkage_rate)
-            with self.tracer.phase("score_upd"):
-                self.train_score_updater.add_score_by_partition(
-                    self.tree_learner.local_leaf_values(out) * self.shrinkage_rate,
-                    self.tree_learner.local_row_leaf(out, n), k)
-                for updater in self.valid_score_updaters:
-                    if multi_host:
-                        # device-tree traversal would mix global and local
-                        # arrays; materialize once and score on host
+            if linear:
+                # the split search fixed the STRUCTURE; now refit every
+                # eligible leaf as a ridge model over its path features
+                # (models/linear_leaves.py). This path is host-synced by
+                # construction — the fit needs the partition and the
+                # gradients on host — so laziness buys nothing here.
+                with self.tracer.phase("host_sync"), \
+                        heartbeat.collective_guard("leaf_count_sync"):
+                    tree, lin_values = self._fit_linear_tree(
+                        out, gradients[k], hessians[k], inbag)
+                with self.tracer.phase("score_upd"):
+                    self.train_score_updater.add_score_by_values(
+                        lin_values * self.shrinkage_rate, k)
+                    for updater in self.valid_score_updaters:
                         updater.add_score_by_tree(tree, k)
-                    else:
-                        updater.add_score_by_device_tree(
-                            out, self.shrinkage_rate, k)
-            with self.tracer.phase("host_sync"), \
-                    heartbeat.collective_guard("leaf_count_sync"):
-                stopped = tree.num_leaves <= 1  # scalar sync: the only wait
+                stopped = tree.num_leaves <= 1
+            else:
+                # enqueue ALL device work for this class before the scalar
+                # stop check: train scores via partition gather (covers
+                # in-bag AND out-of-bag rows: the partition is computed
+                # over all rows, the bag mask only gates the histogram
+                # statistics), then valid scores via device bin-space
+                # traversal. A 0-split tree makes every update a no-op
+                # (leaf values are all zero), so checking afterwards is
+                # safe.
+                tree = LazyTree(out, self.tree_learner,
+                                shrink=self.shrinkage_rate)
+                with self.tracer.phase("score_upd"):
+                    self.train_score_updater.add_score_by_partition(
+                        self.tree_learner.local_leaf_values(out)
+                        * self.shrinkage_rate,
+                        self.tree_learner.local_row_leaf(out, n), k)
+                    for updater in self.valid_score_updaters:
+                        if multi_host:
+                            # device-tree traversal would mix global and
+                            # local arrays; materialize once and score on
+                            # host
+                            updater.add_score_by_tree(tree, k)
+                        else:
+                            updater.add_score_by_device_tree(
+                                out, self.shrinkage_rate, k)
+                with self.tracer.phase("host_sync"), \
+                        heartbeat.collective_guard("leaf_count_sync"):
+                    stopped = tree.num_leaves <= 1  # scalar sync: only wait
             # collective-byte ledger: the meshed learners' wire plan is
             # root + per-split x n_splits (parallel/mesh.py CommPlan);
             # n_splits is on host from the sync above, so the counters
@@ -878,6 +913,40 @@ class GBDT:
         """Hook for DART's tree-dropping (dart.hpp GetTrainingScore)."""
         return self.train_score_updater.score
 
+    def _fit_linear_tree(self, out, grad, hess, inbag):
+        """Materialize the builder's tree and refit its leaves as ridge
+        models (models/linear_leaves.py, docs/Linear-Trees.md).
+
+        Returns (tree, values): the SHRUNK materialized tree and the
+        UNSHRUNK per-row (N,) f64 outputs (the caller applies the
+        learning rate to the score delta, mirroring the constant path's
+        `leaf_values * shrinkage_rate`). The fit runs in unshrunk value
+        space and the whole model block scales multiplicatively, so
+        shrinkage/DART semantics match constant leaves exactly."""
+        from .linear_leaves import fit_linear_leaves, leaf_path_features
+        learner = self.tree_learner
+        n = self.num_data
+        tree = learner._to_host_tree(out, shrink=1.0)
+        if tree.num_leaves <= 1:
+            tree.shrinkage(self.shrinkage_rate)
+            return tree, np.zeros(n, np.float64)
+        row_leaf = np.asarray(learner.local_row_leaf(out, n))
+        feats = leaf_path_features(
+            tree.split_feature, tree.left_child, tree.right_child,
+            tree.leaf_parent, tree.num_leaves,
+            self.config.linear_max_features)
+        chunks, bin_values, fit_chunk = learner.linear_fit_context()
+        const, coeffs, is_lin, values = fit_linear_leaves(
+            feats, tree.leaf_value, tree.leaf_count, bin_values,
+            row_leaf, np.asarray(grad)[:n], np.asarray(hess)[:n],
+            None if inbag is None else np.asarray(inbag)[:n],
+            chunks, fit_chunk, self.config.linear_lambda)
+        if is_lin.any():
+            tree.set_linear(const, coeffs, is_lin, feats,
+                            learner.train_set.real_feature_idx)
+        tree.shrinkage(self.shrinkage_rate)
+        return tree, values
+
     # ------------------------------------------------- fused multi-iteration
     # TPU-first: when nothing in an iteration needs the host (no bagging,
     # no per-iteration metric output, binary/regression with a jitted
@@ -917,6 +986,11 @@ class GBDT:
                      or ignore_train_metrics)
                 and self.early_stopping_round <= 0
                 and getattr(self.objective, "_grad", None) is not None
+                # linear leaves refit on host AFTER each structure, and
+                # the refit changes the residuals the next iteration
+                # sees — the scan cannot bake that in. train_many falls
+                # back to the per-iteration loop transparently.
+                and not bool(getattr(cfg, "linear_tree", False))
                 and type(self.tree_learner).__name__ == "SerialTreeLearner")
 
     def _get_fused_fn(self, num_iters):
@@ -1417,6 +1491,38 @@ class GBDT:
         self._stack_cache = (key, stacked)
         return stacked
 
+    def _stacked_linear_arrays(self, n_used):
+        """Per-leaf linear-model arrays stacked across the first n_used
+        trees, or None when none is linear: (const (T, L) f64,
+        coeff (T, L, C) f64, feat (T, L, C) int32 real column ids,
+        cnt (T, L) int32) with L matching _stacked_model_arrays' leaf
+        axis and C the widest leaf model in the ensemble. Constant
+        leaves (and whole constant trees) carry cnt 0 and zero rows, so
+        a fused serving kernel can branch per (row, tree) lane on
+        cnt > 0 alone (serving/compiled_model.py)."""
+        lin_idx = set(self._linear_model_indices(n_used))
+        if not lin_idx:
+            return None
+        trees = [self.models[i].materialize()
+                 if hasattr(self.models[i], "materialize")
+                 else self.models[i] for i in range(n_used)]
+        max_l = max(t.num_leaves for t in trees)
+        width = max(t.leaf_coeff.shape[1] for i, t in enumerate(trees)
+                    if i in lin_idx)
+        const = np.zeros((n_used, max_l), np.float64)
+        coeff = np.zeros((n_used, max_l, width), np.float64)
+        feat = np.zeros((n_used, max_l, width), np.int32)
+        cnt = np.zeros((n_used, max_l), np.int32)
+        for i, t in enumerate(trees):
+            if i not in lin_idx:
+                continue
+            nl, c = t.num_leaves, t.leaf_coeff.shape[1]
+            const[i, :nl] = t.leaf_const
+            coeff[i, :nl, :c] = t.leaf_coeff
+            feat[i, :nl, :c] = t.leaf_coeff_feat
+            cnt[i, :nl] = t.leaf_coeff_count
+        return const, coeff, feat, cnt
+
     # rows*trees above this run the jitted device traversal (the
     # reference parallelizes prediction with OpenMP, predictor.hpp:82-130;
     # here rows AND trees vectorize on device, class reduction on the MXU).
@@ -1541,13 +1647,20 @@ class GBDT:
         if self._use_device_predict(n, n_used):
             return self._predict_raw_device(x, n_used)
         lv = self._stacked_model_arrays(n_used)[5]
+        lin_idx = self._linear_model_indices(n_used)
         t_cnt = lv.shape[0]
         t_idx = np.arange(t_cnt)
         cls = t_idx % self.num_class       # class-major model list
         block = max(1, min(n, self._HOST_TRAVERSE_CELLS // max(t_cnt, 1)))
         for s in range(0, n, block):
-            node = self._traverse_host(x[s:s + block], n_used)   # (b, T)
+            xb = x[s:s + block]
+            node = self._traverse_host(xb, n_used)               # (b, T)
             vals = lv[t_idx[None, :], ~node]                     # (b, T)
+            # linear leaves: the gathered constant is exactly the
+            # missing-value fallback, so overwrite in place per tree
+            for i in lin_idx:
+                vals[:, i] = self.models[i]._linear_values(
+                    xb, (~node[:, i]).astype(np.int32), vals[:, i])
             for k in range(self.num_class):
                 out[s:s + block, k] = vals[:, cls == k].sum(axis=1)
         return out
@@ -1575,6 +1688,12 @@ class GBDT:
         tunes its own predictors."""
         if getattr(self, "force_host_predict", False):
             return False
+        if self._linear_model_indices(n_used):
+            # the training-side device traversal gathers CONSTANTS; the
+            # fused traversal+dot kernels live in serving
+            # (serving/compiled_model.py) — training predict stays on
+            # the host f64 path for linear models, even under "force"
+            return False
         knob = os.environ.get("LIGHTGBM_TPU_DEVICE_PREDICT")
         if knob in (None, "", "1"):  # "1" was the legacy auto default
             knob = str(getattr(self, "device_predict", "auto"))
@@ -1584,6 +1703,13 @@ class GBDT:
         if knob in ("force", "true", "+"):
             return True
         return n * n_used >= self.DEVICE_PREDICT_CELLS
+
+    def _linear_model_indices(self, n_used):
+        """Model-list indices of linear-leaf trees among the first
+        n_used. LazyTree carries is_linear=False as a class attribute,
+        so this probe never forces a materialization."""
+        return [i for i in range(n_used)
+                if getattr(self.models[i], "is_linear", False)]
 
     def _traverse_host(self, xb, n_used):
         """Host traversal of one row block through all stacked trees:
@@ -1664,18 +1790,30 @@ class GBDT:
         return pairs
 
     def save_model_to_string(self, num_iteration=-1):
-        """gbdt.cpp:468-513 text format."""
-        lines = [self.name,
-                 f"num_class={self.num_class}",
-                 f"label_index={self.label_idx}",
-                 f"max_feature_idx={self.max_feature_idx}"]
+        """gbdt.cpp:468-513 text format.
+
+        Models with linear leaves declare `format_version=2` right
+        after the name line (MODEL_FORMAT_VERSION); constant-leaf
+        models omit the line entirely so their output stays
+        byte-identical to every pre-linear reader and writer."""
+        n_used = len(self.models) if num_iteration <= 0 else min(
+            num_iteration * self.num_class, len(self.models))
+        lines = [self.name]
+        if any(getattr(self.models[i], "is_linear", False)
+               for i in range(n_used)):
+            lines.append(f"format_version={MODEL_FORMAT_VERSION}")
+        lines += [f"num_class={self.num_class}",
+                  f"label_index={self.label_idx}",
+                  f"max_feature_idx={self.max_feature_idx}"]
         if self.objective is not None:
             lines.append(f"objective={self.objective.name}")
+        elif getattr(self, "_loaded_objective_name", ""):
+            # a loaded booster has no live objective; keep the declared
+            # name so save(load(s)) round-trips byte-identically
+            lines.append(f"objective={self._loaded_objective_name}")
         lines.append(f"sigmoid={self.sigmoid:g}")
         lines.append("feature_names=" + " ".join(self.feature_names))
         lines.append("")
-        n_used = len(self.models) if num_iteration <= 0 else min(
-            num_iteration * self.num_class, len(self.models))
         for i in range(n_used):
             lines.append(f"Tree={i}")
             lines.append(self.models[i].to_string())
@@ -1712,6 +1850,13 @@ class GBDT:
                     return ln
             return ""
 
+        line = find_line("format_version=")
+        fmt = int(line.split("=")[1]) if line else 1
+        if fmt > MODEL_FORMAT_VERSION:
+            Log.fatal("model declares format_version=%d but this reader "
+                      "supports versions <= %d — load it with the "
+                      "lightgbm_tpu release that wrote it", fmt,
+                      MODEL_FORMAT_VERSION)
         line = find_line("num_class=")
         if not line:
             Log.fatal("Model file doesn't specify the number of classes")
@@ -1724,6 +1869,9 @@ class GBDT:
         if not line:
             Log.fatal("Model file doesn't specify max_feature_idx")
         self.max_feature_idx = int(line.split("=")[1])
+        line = find_line("objective=")
+        self._loaded_objective_name = (line.split("=", 1)[1].strip()
+                                       if line else "")
         line = find_line("sigmoid=")
         self.sigmoid = float(line.split("=")[1]) if line else -1.0
         line = find_line("feature_names=")
@@ -1742,7 +1890,8 @@ class GBDT:
                     if lines[i].startswith("feature importances:"):
                         break
                     i += 1
-                self.models.append(Tree.from_string("\n".join(lines[start:i])))
+                self.models.append(Tree.from_string(
+                    "\n".join(lines[start:i]), format_version=fmt))
             else:
                 i += 1
         Log.info("Finished loading %d models", len(self.models))
@@ -1869,6 +2018,7 @@ class GBDT:
         # truncation) — so the in-bin arrays ride along, concatenated
         # across trees
         n_splits, tib, sfi = [], [], []
+        lin_counts, lin_feats = [], []
         for model in self.models:
             tree = (model.materialize() if hasattr(model, "materialize")
                     else model)
@@ -1877,11 +2027,31 @@ class GBDT:
             if ns > 0:
                 tib.append(np.asarray(tree.threshold_in_bin[:ns], np.int32))
                 sfi.append(np.asarray(tree.split_feature[:ns], np.int32))
+            # linear leaves also need their INNER coefficient feature
+            # ids for bin-space re-scoring after resume (the text
+            # format stores real column ids only): per-leaf counts +
+            # flattened inner ids, concatenated across trees
+            if getattr(tree, "is_linear", False):
+                cnts = np.asarray(tree.leaf_coeff_count, np.int32)
+                lin_counts.append(cnts)
+                lin_feats.append(np.concatenate(
+                    [tree.leaf_coeff_feat_inner[leaf, :cnts[leaf]]
+                     for leaf in range(tree.num_leaves)]
+                    or [np.zeros(0, np.int32)]).astype(np.int32))
+            else:
+                lin_counts.append(np.zeros(ns + 1, np.int32))
+                lin_feats.append(np.zeros(0, np.int32))
         state["tree_n_splits"] = np.asarray(n_splits, np.int32)
         state["tree_threshold_in_bin"] = (
             np.concatenate(tib) if tib else np.zeros(0, np.int32))
         state["tree_split_feature_inner"] = (
             np.concatenate(sfi) if sfi else np.zeros(0, np.int32))
+        state["tree_leaf_coeff_counts"] = (
+            np.concatenate(lin_counts) if lin_counts
+            else np.zeros(0, np.int32))
+        state["tree_leaf_feat_inner"] = (
+            np.concatenate(lin_feats) if lin_feats
+            else np.zeros(0, np.int32))
         return state
 
     def restore_training_state(self, state):
@@ -1906,11 +2076,28 @@ class GBDT:
             offsets = np.concatenate([[0], np.cumsum(n_splits)])
             tib = np.asarray(state["tree_threshold_in_bin"], np.int32)
             sfi = np.asarray(state["tree_split_feature_inner"], np.int32)
+            lin_counts = np.asarray(
+                state.get("tree_leaf_coeff_counts", []), np.int32)
+            lin_feats = np.asarray(
+                state.get("tree_leaf_feat_inner", []), np.int32)
+            leaf_off = np.concatenate([[0], np.cumsum(n_splits + 1)])
+            feat_pos = 0
             for idx, tree in enumerate(self.models):
                 lo, hi = offsets[idx], offsets[idx + 1]
                 if hi > lo:
                     tree.threshold_in_bin = tib[lo:hi].copy()
                     tree.split_feature = sfi[lo:hi].copy()
+                if len(lin_counts) != leaf_off[-1]:
+                    continue  # pre-linear checkpoint (no linear trees)
+                cnts = lin_counts[leaf_off[idx]:leaf_off[idx + 1]]
+                if getattr(tree, "is_linear", False):
+                    for leaf in range(tree.num_leaves):
+                        k = int(cnts[leaf])
+                        tree.leaf_coeff_feat_inner[leaf, :k] = \
+                            lin_feats[feat_pos:feat_pos + k]
+                        feat_pos += k
+                else:
+                    feat_pos += int(cnts.sum())
         # load_model_from_string prepares for PREDICTION (treats every
         # tree as an init tree); a resume continues TRAINING, so the
         # split between init trees and this run's own is the captured one

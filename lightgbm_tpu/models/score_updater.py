@@ -105,6 +105,22 @@ class ScoreUpdater:
         upd = jnp.take(jnp.asarray(leaf_values, dtype=jnp.float32), row_leaf)
         self.score = self.score.at[curr_class].add(upd)
 
+    def add_score_by_values(self, values, curr_class):
+        """score += values: one (N,) per-row delta computed on host —
+        the linear-leaf training path (models/linear_leaves.py), where
+        a leaf's contribution varies per row so a leaf-value gather
+        cannot express it."""
+        self.score = self.score.at[curr_class].add(
+            jnp.asarray(np.asarray(values, dtype=np.float32)))
+
+    def _tree_bin_values(self, tree):
+        """Bin representative table when `tree` needs one (linear
+        leaves), else None — keeps the constant-leaf path allocation-
+        free and works on datasets with no resident table."""
+        if getattr(tree, "is_linear", False):
+            return self.dataset.bin_value_table()
+        return None
+
     def _decode_maps(self):
         """(feat_slot, feat_off, feat_nb) device arrays: bundle decode
         when the dataset is bundled, identity maps otherwise."""
@@ -155,11 +171,15 @@ class ScoreUpdater:
 
     def add_score_by_tree(self, tree, curr_class):
         """Host bin-space traversal (re-scoring loaded/materialized models)."""
-        vals = tree.predict_by_bins(self.dataset.traversal_bins()).astype(np.float32)
+        vals = tree.predict_by_bins(
+            self.dataset.traversal_bins(),
+            self._tree_bin_values(tree)).astype(np.float32)
         self.score = self.score.at[curr_class].add(jnp.asarray(vals))
 
     def sub_score_by_tree(self, tree, curr_class):
-        vals = tree.predict_by_bins(self.dataset.traversal_bins()).astype(np.float32)
+        vals = tree.predict_by_bins(
+            self.dataset.traversal_bins(),
+            self._tree_bin_values(tree)).astype(np.float32)
         self.score = self.score.at[curr_class].add(jnp.asarray(-vals))
 
     def add_score_by_trees(self, trees, num_class, sign=1.0):
@@ -170,7 +190,7 @@ class ScoreUpdater:
         delta = np.zeros((self.num_class, self.num_data), dtype=np.float32)
         for i, tree in enumerate(trees):
             delta[i % num_class] += sign * tree.predict_by_bins(
-                self.dataset.traversal_bins())
+                self.dataset.traversal_bins(), self._tree_bin_values(tree))
         self.score = self.score + jnp.asarray(delta)
 
     def sub_score_by_trees(self, trees, num_class):

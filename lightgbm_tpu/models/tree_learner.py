@@ -679,6 +679,22 @@ class SerialTreeLearner:
         """Leaf values as a process-local array (overridden multi-host)."""
         return out["leaf_value"]
 
+    def linear_fit_context(self):
+        """(chunks, bin_value_table, fit_chunk) for the linear leaf fit
+        (models/linear_leaves.py). The resident path exposes the whole
+        dataset as ONE (lo, hi, bins, base) block over the virtual-
+        space traversal bins; the fit re-chunks it on the
+        device_row_chunk grid the streamed learner's blocks align to,
+        which is what keeps the f64 accumulation bit-identical across
+        the two paths."""
+        tv = self.train_set.traversal_bins()
+        chunks = [(0, self.num_data, tv, 0)]
+        # the DATASET's representative table, not the learner's split-
+        # threshold table: the fit must dot against the same (finite,
+        # inf-clamped) values Tree.predict_by_bins will use
+        return chunks, self.train_set.bin_value_table(), int(
+            self.config.device_row_chunk)
+
     def _bundle_expand_fn(self):
         """Stored->virtual histogram expansion closure (io/bundling.py
         expansion_maps). Slices the histogram to the REAL slot count

@@ -30,6 +30,17 @@ GBDT's host predict path (a (B, T) int32 transfer plus a tiny matmul;
 the traversal is the O(depth * B * T) part and stays on device). The
 `_device` variants keep the whole pipeline on device in f32 (reduction
 on the MXU) for throughput-bound callers that tolerate ~1e-6.
+
+Linear-leaf models (models/linear_leaves.py) freeze their per-leaf
+coefficient blocks into COEF_PAD-padded SoA arrays alongside the node
+arrays and fuse the per-leaf dot product into the traversal kernels
+(_linraw_kernel/_lintransformed_kernel) — one dispatch per request
+block, same shape-stability rules, so a linear challenger hot-swaps
+behind a constant incumbent with zero cold dispatches. The exact f32
+precision keeps the linear reduce on host in f64, bit-identical to
+GBDT's host path; bf16 stores coefficients in bfloat16 and the pinned
+`accuracy_bound` grows a coefficient-rounding term (see
+_pin_accuracy_bound).
 """
 
 import functools
@@ -124,6 +135,62 @@ def _transformed16_kernel(xb, sf, thr, cat, lc, rc, lv16, node0, onehot16,
     return raw
 
 
+def _linear_leaf_values(xb, node, lv, const, coef, cfeat, ccnt):
+    """(B, T) per-lane leaf outputs for linear-leaf models, fused with
+    the traversal result: gather each (row, tree) lane's leaf model —
+    intercept, COEF_PAD coefficient/feature slots, live count — dot the
+    row's gathered feature values against the coefficients, and fall
+    back to the constant leaf value where the lane's leaf is constant
+    (cnt == 0) or a live feature is NaN (missing values have no
+    coordinate; Tree._linear_values host semantics). Arithmetic is f32
+    throughout; bf16 precision passes bf16-stored value arrays which
+    upcast at the gather, so storage rounding is the only bf16 error
+    (the pinned accuracy_bound's coefficient term)."""
+    leaf = ~node                                             # (B, T)
+    b = xb.shape[0]
+    t_idx = jnp.arange(lv.shape[0])[None, :]                 # (1, T)
+    base = lv[t_idx, leaf].astype(jnp.float32)               # (B, T)
+    cst = const[t_idx, leaf].astype(jnp.float32)             # (B, T)
+    cn = ccnt[t_idx, leaf]                                   # (B, T)
+    j = jnp.arange(coef.shape[2])[None, None, :]             # (1, 1, C)
+    co = coef[t_idx[:, :, None], leaf[:, :, None], j] \
+        .astype(jnp.float32)                                 # (B, T, C)
+    ft = cfeat[t_idx[:, :, None], leaf[:, :, None], j]       # (B, T, C)
+    xf = xb[jnp.arange(b)[:, None, None], ft]                # (B, T, C)
+    valid = j < cn[:, :, None]
+    live_nan = jnp.isnan(xf) & valid
+    dot = jnp.sum(jnp.where(valid & ~jnp.isnan(xf), co * xf, 0.0),
+                  axis=-1)
+    lin = cst + dot
+    use_lin = (cn > 0) & ~jnp.any(live_nan, axis=-1)
+    return jnp.where(use_lin, lin, base)
+
+
+@jax.jit
+def _linraw_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot, depth,
+                   const, coef, cfeat, ccnt):
+    """(B, F) f32 rows -> (B, K) f32 raw class sums with the per-leaf
+    linear dot fused into the same program as the traversal (one
+    dispatch per request block, like the constant-leaf _raw_kernel;
+    class reduction accumulates f32 on the MXU)."""
+    node = device_traverse(xb, sf, thr, cat, lc, rc, node0, depth)
+    vals = _linear_leaf_values(xb, node, lv, const, coef, cfeat, ccnt)
+    return jax.lax.dot(vals, cls_onehot.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnums=(9,))
+def _lintransformed_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot,
+                           sigmoid, depth, const, coef, cfeat, ccnt):
+    raw = _linraw_kernel(xb, sf, thr, cat, lc, rc, lv, node0, cls_onehot,
+                         depth, const, coef, cfeat, ccnt)
+    if sigmoid > 0 and cls_onehot.shape[1] == 1:
+        return 1.0 / (1.0 + jnp.exp(-2.0 * sigmoid * raw))
+    if cls_onehot.shape[1] > 1:
+        return jax.nn.softmax(raw, axis=1)
+    return raw
+
+
 def _bf16_round(arr):
     """Host-side f64 view of an array after a round-trip through
     bfloat16 (the rounding the bf16 leaf gather applies on device)."""
@@ -156,6 +223,13 @@ TREE_PAD = 16
 # with the same num_leaves knob can still grow different ACTUAL leaf
 # counts, and a one-column difference would force a full recompile
 NODE_PAD = 32
+# linear-leaf models: every leaf's coefficient block pads to this fixed
+# width, so two generations with different realized leaf-model widths
+# (or a linear challenger behind a linear incumbent) still freeze to
+# identical kernel shapes. Training's `linear_max_features` knob must
+# stay <= COEF_PAD (config.py enforces the default; from_model_file
+# re-checks loaded models).
+COEF_PAD = 8
 
 
 def _pad_up(n, multiple):
@@ -197,6 +271,10 @@ class CompiledPredictor:
     model_path = None
     profile_path = None
     profile = None
+    # flipped in __init__ when the booster carries linear-leaf trees
+    # (models/linear_leaves.py); class default keeps the empty-model
+    # early return consistent
+    is_linear = False
 
     def __init__(self, booster, num_iteration=-1,
                  max_batch_rows=DEFAULT_MAX_BATCH_ROWS, row_buckets=None,
@@ -270,6 +348,49 @@ class CompiledPredictor:
         self._lv_np = lv_p
         self._onehot_np = onehot_p.astype(np.float32)
         self._lv32 = self._onehot32 = None
+        # linear-leaf models (models/linear_leaves.py): freeze the
+        # per-leaf coefficient blocks into COEF_PAD-padded SoA arrays
+        # alongside the node arrays. Constant models skip all of this —
+        # their kernel set and shapes are untouched.
+        lin = booster._stacked_linear_arrays(n_used)
+        self.is_linear = lin is not None
+        if self.is_linear:
+            const, coef, cfeat, ccnt = lin
+            if coef.shape[2] > COEF_PAD:
+                raise ValueError(
+                    f"model's widest leaf model has {coef.shape[2]} "
+                    f"coefficients but serving pads to COEF_PAD="
+                    f"{COEF_PAD}; retrain with linear_max_features <= "
+                    f"{COEF_PAD}")
+            l_pad = lv_p.shape[1]
+            cw = COEF_PAD - coef.shape[2]
+
+            def pad3(a, fill=0):
+                a = np.concatenate(
+                    [a, np.full((a.shape[0], l_pad - a.shape[1])
+                                + a.shape[2:], fill, a.dtype)], axis=1)
+                if a.ndim == 3 and cw > 0:
+                    a = np.concatenate(
+                        [a, np.full(a.shape[:2] + (cw,), fill, a.dtype)],
+                        axis=2)
+                return _pad_rows(a, pad, fill)
+
+            # host f64 exact-path arrays stay UNPADDED on the tree axis
+            # (like _lv64); device arrays pad on every axis
+            self._lin_const64 = np.concatenate(
+                [const, np.zeros((n_used, l_pad - const.shape[1]))],
+                axis=1)
+            self._lin_coef64 = pad3(coef)[:n_used]
+            self._lin_feat = pad3(cfeat)[:n_used]
+            self._lin_cnt = pad3(ccnt)[:n_used]
+            store = jnp.bfloat16 if serving_precision == "bf16" else \
+                jnp.float32
+            self._lin_dev = (
+                jnp.asarray(pad3(const), store),
+                jnp.asarray(pad3(coef), store),
+                jnp.asarray(pad3(cfeat)),
+                jnp.asarray(pad3(ccnt)),
+            )
         if serving_precision == "bf16":
             # compact node layout (int16 where node/feature ids fit —
             # at serving tree sizes they always do) + bf16 value arrays;
@@ -286,11 +407,12 @@ class CompiledPredictor:
             self._lv16 = jnp.asarray(lv_p, jnp.bfloat16)
             self._onehot16 = jnp.asarray(onehot_p.astype(np.float32),
                                          jnp.bfloat16)   # 0/1: exact
-            self.accuracy_bound = self._pin_accuracy_bound(n_used)
+            self.accuracy_bound = self._pin_accuracy_bound(
+                n_used, np.array(sf), np.array(thr))
         if warmup:
             self.warm_up(device_kernels=warm_device_kernels)
 
-    def _pin_accuracy_bound(self, n_used):
+    def _pin_accuracy_bound(self, n_used, sf=None, thr=None):
         """Worst-case |bf16 output - exact f64 output| over ANY input,
         derived from the frozen leaf values: traversal decisions are
         exact, so the only error sources are the bf16 rounding of each
@@ -300,11 +422,46 @@ class CompiledPredictor:
         so the pinned bound covers raw AND transformed outputs. A 2x
         margin absorbs rounding-mode asymmetries. The serving skew
         monitor adopts this as its tolerance (server.build_monitors),
-        keeping shadow scoring armed and quiet by construction."""
+        keeping shadow scoring armed and quiet by construction.
+
+        Linear leaves add a coefficient-rounding term: per tree, the
+        worst leaf's |const - bf16(const)| + sum_j |coef_j -
+        bf16(coef_j)| * env(feat_j), where env(f) is the model's OWN
+        calibration envelope for feature f — twice the largest
+        |threshold| any split placed on f (floored at 1.0). Inputs
+        inside the envelope are covered by construction; a deployment
+        feeding features far outside the range its splits ever tested
+        is already out of calibration, and the skew monitor (whose
+        tolerance this bound becomes) will surface it."""
         err_t = np.abs(self._lv64 - _bf16_round(self._lv64)).max(axis=1)
+        if getattr(self, "is_linear", False):
+            env = np.ones(self.num_features, np.float64)
+            if sf is not None and sf.size:
+                np.maximum.at(env, sf.reshape(-1),
+                              2.0 * np.abs(thr.reshape(-1)))
+            cerr = (np.abs(self._lin_coef64
+                           - _bf16_round(self._lin_coef64))
+                    * env[self._lin_feat])
+            valid = (np.arange(self._lin_coef64.shape[2])[None, None, :]
+                     < self._lin_cnt[:, :, None])
+            lin_err_t = (np.abs(self._lin_const64
+                                - _bf16_round(self._lin_const64))
+                         + np.where(valid, cerr, 0.0).sum(axis=2)
+                         ).max(axis=1)
+            err_t = np.maximum(err_t, lin_err_t)
         raw_bound = float((err_t @ self._onehot64).max())
-        mags = float((np.abs(self._lv64).max(axis=1)
-                      @ self._onehot64).max())
+        mag_t = np.abs(self._lv64).max(axis=1)
+        if getattr(self, "is_linear", False):
+            # the f32-accumulation slack scales with the largest value a
+            # lane can contribute — for a linear leaf that is the whole
+            # envelope-bounded dot, not just the constant fallback
+            lin_mag_t = (np.abs(self._lin_const64)
+                         + np.where(valid,
+                                    np.abs(self._lin_coef64)
+                                    * env[self._lin_feat],
+                                    0.0).sum(axis=2)).max(axis=1)
+            mag_t = np.maximum(mag_t, lin_mag_t)
+        mags = float((mag_t @ self._onehot64).max())
         slack = mags * n_used * float(np.finfo(np.float32).eps)
         factor = 1.0
         if self.sigmoid > 0 and self.num_class == 1:
@@ -366,13 +523,25 @@ class CompiledPredictor:
             with LEDGER.label(f"serving_bucket_{b}"):
                 jax.block_until_ready(self._dispatch_leaf(xb))
                 self._warmed.add(("leaf", b))
-                if bf16:
+                if bf16 and self.is_linear:
+                    # linear bf16 endpoints dispatch the fused linear
+                    # kernels; the constant bf16 pair is never hit
+                    jax.block_until_ready(self._dispatch_linraw(xb))
+                    jax.block_until_ready(
+                        self._dispatch_lintransformed(xb))
+                    self._warmed.update((("linraw", b), ("lintr", b)))
+                elif bf16:
                     # predict/predict_raw dispatch the bf16 kernels —
                     # every endpoint's (kernel, bucket) pair pre-warms
                     jax.block_until_ready(self._dispatch_raw16(xb))
                     jax.block_until_ready(self._dispatch_transformed16(xb))
                     self._warmed.update((("raw16", b), ("tr16", b)))
-                if device_kernels:
+                if device_kernels and self.is_linear and not bf16:
+                    jax.block_until_ready(self._dispatch_linraw(xb))
+                    jax.block_until_ready(
+                        self._dispatch_lintransformed(xb))
+                    self._warmed.update((("linraw", b), ("lintr", b)))
+                elif device_kernels and not self.is_linear:
                     jax.block_until_ready(self._dispatch_raw32(xb))
                     jax.block_until_ready(self._dispatch_transformed32(xb))
                     self._warmed.update((("raw32", b), ("tr32", b)))
@@ -419,6 +588,55 @@ class CompiledPredictor:
         return _transformed16_kernel(xb, sf, thr, cat, lc, rc, self._lv16,
                                      node0, self._onehot16,
                                      self._depth_arg, self.sigmoid)
+
+    # linear-leaf fused kernels: ONE source kernel pair serves both
+    # precisions — the f32 ladder passes f32 value arrays, the bf16
+    # ladder passes the bf16-stored ones plus the compact node layout
+    # (values upcast at the gather; each dtype signature is its own
+    # executable, warmed by warm_up). Traversal thresholds are the
+    # f32-safe cast either way, so decisions never move.
+    def _linear_args(self):
+        if self.serving_precision == "bf16":
+            return self._dev16, (self._lv16, self._onehot16)
+        return self._dev, self._f32_values()
+
+    def _dispatch_linraw(self, xb):
+        (sf, thr, cat, lc, rc, node0), (lv, onehot) = self._linear_args()
+        const, coef, cfeat, ccnt = self._lin_dev
+        return _linraw_kernel(xb, sf, thr, cat, lc, rc, lv, node0, onehot,
+                              self._depth_arg, const, coef, cfeat, ccnt)
+
+    def _dispatch_lintransformed(self, xb):
+        (sf, thr, cat, lc, rc, node0), (lv, onehot) = self._linear_args()
+        const, coef, cfeat, ccnt = self._lin_dev
+        return _lintransformed_kernel(
+            xb, sf, thr, cat, lc, rc, lv, node0, onehot, self.sigmoid,
+            self._depth_arg, const, coef, cfeat, ccnt)
+
+    def _linear_host_values(self, x, leaves):
+        """Exact-path value stage for linear models: (N, T) f64 per-tree
+        outputs from device-traversed leaf indices, mirroring
+        Tree._linear_values BIT-FOR-BIT — same f64 arithmetic, same
+        sequential accumulation order over coefficient slots (the
+        COEF_PAD padding slots add an exact 0.0, see the comment in
+        tree.py), same NaN-fallback semantics."""
+        t_idx = np.arange(self.num_trees)[None, :]
+        base = self._lv64[t_idx, leaves]                     # (N, T)
+        cst = self._lin_const64[t_idx, leaves]
+        cn = self._lin_cnt[t_idx, leaves]                    # (N, T)
+        co = self._lin_coef64[t_idx[:, :, None], leaves[:, :, None],
+                              np.arange(COEF_PAD)[None, None, :]]
+        ft = self._lin_feat[t_idx[:, :, None], leaves[:, :, None],
+                            np.arange(COEF_PAD)[None, None, :]]
+        xf = x.astype(np.float64)[
+            np.arange(x.shape[0])[:, None, None], ft]        # (N, T, C)
+        valid = (np.arange(COEF_PAD)[None, None, :] < cn[:, :, None])
+        live_nan = np.isnan(xf) & valid
+        lin = cst.copy()
+        for j in range(COEF_PAD):
+            lin += np.where(valid[:, :, j] & ~np.isnan(xf[:, :, j]),
+                            co[:, :, j] * xf[:, :, j], 0.0)
+        return np.where((cn > 0) & ~np.any(live_nan, axis=2), lin, base)
 
     def _canon(self, x):
         """(N, num_features) f32 view of arbitrary row input: width is
@@ -485,10 +703,23 @@ class CompiledPredictor:
         if self.num_trees == 0 or n == 0:
             return np.zeros((n, self.num_class))
         if self.serving_precision == "bf16":
+            if self.is_linear:
+                return self._blocks(x, self._dispatch_linraw,
+                                    "linraw").astype(np.float64)
             return self._blocks(x, self._dispatch_raw16,
                                 "raw16").astype(np.float64)
         leaves = self._blocks(x, self._dispatch_leaf,
                               "leaf")[:, :self.num_trees]     # (N, T)
+        if self.is_linear:
+            vals = self._linear_host_values(x, leaves)       # (N, T) f64
+            # GBDT's host path reduces each class with a pairwise
+            # np.sum over its tree subset; a BLAS matmul associates
+            # differently in the last ulp, so mirror the sum exactly
+            cls = np.arange(self.num_trees) % self.num_class
+            out = np.empty((x.shape[0], self.num_class))
+            for k in range(self.num_class):
+                out[:, k] = vals[:, cls == k].sum(axis=1)
+            return out
         vals = self._lv64[np.arange(self.num_trees)[None, :], leaves]
         return vals @ self._onehot64                         # (N, K) f64
 
@@ -501,6 +732,9 @@ class CompiledPredictor:
             x = self._canon(x)
             if x.shape[0] == 0:
                 return np.zeros((0, self.num_class))
+            if self.is_linear:
+                return self._blocks(x, self._dispatch_lintransformed,
+                                    "lintr").astype(np.float64)
             return self._blocks(x, self._dispatch_transformed16,
                                 "tr16").astype(np.float64)
         raw = self.predict_raw(x)
@@ -517,6 +751,12 @@ class CompiledPredictor:
         n = x.shape[0]
         if self.num_trees == 0 or n == 0:
             return np.zeros((n, self.num_class))
+        if self.is_linear:
+            # linear models route the device variants through the fused
+            # linear kernels (bf16 predictors: bf16-stored values —
+            # `accuracy_bound` applies instead of the ~1e-6 f32 figure)
+            return self._blocks(x, self._dispatch_linraw,
+                                "linraw").astype(np.float64)
         return self._blocks(x, self._dispatch_raw32,
                             "raw32").astype(np.float64)
 
@@ -526,6 +766,9 @@ class CompiledPredictor:
         n = x.shape[0]
         if self.num_trees == 0 or n == 0:
             return np.zeros((n, self.num_class))
+        if self.is_linear:
+            return self._blocks(x, self._dispatch_lintransformed,
+                                "lintr").astype(np.float64)
         return self._blocks(x, self._dispatch_transformed32,
                             "tr32").astype(np.float64)
 
@@ -542,6 +785,7 @@ class CompiledPredictor:
             "buckets": list(self.buckets),
             "serving_precision": self.serving_precision,
             "accuracy_bound": self.accuracy_bound,
+            "is_linear": self.is_linear,
             "model_path": self.model_path,
             "has_profile": self.profile is not None,
         }

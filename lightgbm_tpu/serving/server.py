@@ -582,6 +582,10 @@ def swap_model(srv, predictor, drift=None, skew=None, version=None):
     handler.monitor_state = (predictor, drift, skew)
     srv.model_version = version
     srv.swap_count = int(getattr(srv, "swap_count", 0)) + 1
+    Log.info("hot-swap: now serving version=%s trees=%d leaves=%s "
+             "precision=%s", version, predictor.num_trees,
+             "linear" if getattr(predictor, "is_linear", False)
+             else "constant", predictor.serving_precision)
     return old
 
 

@@ -33,7 +33,7 @@ import threading
 
 import numpy as np
 
-IMPORTANCE_TYPES = ("split", "gain")
+IMPORTANCE_TYPES = ("split", "gain", "coeff")
 
 
 def _materialize(tree):
@@ -61,16 +61,45 @@ def tree_split_records(tree):
     }
 
 
+def tree_coeff_importance(tree, num_features):
+    """Per-feature coefficient importance of one tree's linear leaves
+    (models/linear_leaves.py): for every linear leaf l and coefficient
+    j, importance[feature(l, j)] += |coef[l, j]| * gain(parent(l)) —
+    the magnitude of the leaf model's use of the feature, weighted by
+    the gain of the split that carved the leaf out, so coefficients in
+    high-signal regions count more than equal-magnitude ones in noise
+    leaves. Derived from the materialized Tree's arrays only, so it is
+    bit-identical across engines by the same contract as split/gain.
+    Constant-leaf trees contribute an all-zero vector."""
+    out = np.zeros(int(num_features), np.float64)
+    tree = _materialize(tree)
+    if not getattr(tree, "is_linear", False):
+        return out
+    gain = np.asarray(tree.split_gain, np.float64)
+    for leaf in range(int(tree.num_leaves)):
+        k = int(tree.leaf_coeff_count[leaf])
+        if k == 0:
+            continue
+        parent = int(tree.leaf_parent[leaf])
+        w = gain[parent] if parent >= 0 else 0.0
+        np.add.at(out, tree.leaf_coeff_feat[leaf, :k],
+                  np.abs(tree.leaf_coeff[leaf, :k]) * w)
+    return out
+
+
 class SplitLedger:
-    """Per-feature split/gain accumulator with reference semantics:
-    `split` importance counts how many splits used the feature, `gain`
-    sums split_gain over them. add_tree() is pure numpy over one
-    tree's flat arrays — O(num_leaves) per tree."""
+    """Per-feature split/gain/coeff accumulator with reference
+    semantics: `split` importance counts how many splits used the
+    feature, `gain` sums split_gain over them, `coeff` sums gain-
+    weighted linear-leaf coefficient magnitudes (tree_coeff_importance).
+    add_tree() is pure numpy over one tree's flat arrays —
+    O(num_leaves) per tree."""
 
     def __init__(self, num_features):
         self.num_features = int(num_features)
         self.split_counts = np.zeros(self.num_features, np.int64)
         self.gain_sums = np.zeros(self.num_features, np.float64)
+        self.coeff_sums = np.zeros(self.num_features, np.float64)
         self.n_trees = 0
         self.n_splits = 0
 
@@ -80,6 +109,12 @@ class SplitLedger:
         if len(feat):
             np.add.at(self.split_counts, feat, 1)
             np.add.at(self.gain_sums, feat, rec["gain"])
+        # probe the wrapper, not the materialization: LazyTree carries
+        # is_linear=False as a class attribute (builder output is
+        # always constant-leaf), so this never forces a host transfer
+        if getattr(tree, "is_linear", False):
+            self.coeff_sums += tree_coeff_importance(tree,
+                                                     self.num_features)
         self.n_trees += 1
         self.n_splits += len(feat)
         return rec
@@ -89,6 +124,8 @@ class SplitLedger:
             return self.split_counts.copy()
         if importance_type == "gain":
             return self.gain_sums.copy()
+        if importance_type == "coeff":
+            return self.coeff_sums.copy()
         raise ValueError(
             f"Unknown importance type {importance_type!r} "
             f"(expected one of {IMPORTANCE_TYPES})")

@@ -1,0 +1,345 @@
+"""Linear-leaf trees (models/linear_leaves.py, docs/Linear-Trees.md).
+
+The end-to-end contract for `linear_tree=true`:
+
+- fit quality: on piece-wise linear data the per-leaf ridge models beat
+  constant leaves at equal tree count;
+- engine parity: serial and out-of-core training produce BYTE-identical
+  model strings (the canonical-chunk f64 accumulation contract) and
+  bit-identical coeff importances;
+- serialization: save -> load -> save round-trips byte-identically
+  under format_version=2; constant models stay byte-identical to v1;
+  the loader rejects newer versions, unknown sections, and linear
+  sections under v1 with clear errors (forward compat, both
+  directions);
+- fault tolerance: crash + checkpoint-resume reproduces the reference
+  model byte-identically with bagging/feature_fraction active;
+- serving: CompiledPredictor's exact path is bit-identical to the GBDT
+  host path (NaN fallback included), bf16 stays within its pinned
+  accuracy_bound, and a linear challenger hot-swaps over a constant
+  incumbent with zero 5xx and zero cold dispatches.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import callback
+from lightgbm_tpu.fleet import ModelRegistry
+from lightgbm_tpu.fleet.hotswap import HotSwapper
+from lightgbm_tpu.fleet.pipeline import auc_score
+from lightgbm_tpu.models.gbdt import GBDT, create_boosting
+from lightgbm_tpu.serving import CompiledPredictor, make_server
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.log import LightGBMError
+
+BASE = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+        "learning_rate": 0.1, "verbose": -1, "device_row_chunk": 256,
+        "linear_tree": True}
+OOC = dict(BASE, out_of_core=True, block_rows=512)
+
+
+def _data(n=3000, f=10, seed=7):
+    """Piece-wise linear ground truth: within each region of the
+    feature space the response is linear in x — the regime linear
+    leaves are built for."""
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, f))
+    lin = x[:, 0] * 1.5 - x[:, 1] * 0.8 + 0.3 * x[:, 2] * x[:, 3]
+    y = (lin + 0.3 * rng.standard_normal(n) > 0).astype(np.float64)
+    return np.asarray(x, np.float64), y
+
+
+def _train(params, rounds=10, n=3000, seed=7):
+    x, y = _data(n=n, seed=seed)
+    return lgb.train(dict(params), lgb.Dataset(x, y, params=dict(params)),
+                     num_boost_round=rounds, verbose_eval=False)
+
+
+def _model_str(booster):
+    return booster.gbdt.save_model_to_string(-1)
+
+
+def _load(s):
+    b = create_boosting(s.splitlines()[0])
+    b.load_model_from_string(s)
+    return b
+
+
+# ----------------------------------------------------------- fit quality
+def test_linear_beats_constant_at_equal_trees():
+    x, y = _data()
+    xt, yt = _data(seed=99)
+    lin = _train(BASE)
+    const = _train(dict(BASE, linear_tree=False))
+    auc_lin = auc_score(yt, lin.predict(xt).reshape(-1))
+    auc_const = auc_score(yt, const.predict(xt).reshape(-1))
+    assert auc_lin > auc_const + 0.001, (auc_lin, auc_const)
+
+
+def test_degenerate_leaves_fall_back_to_constants():
+    # a constant feature column can never support a regression — tiny
+    # leaves and zero-variance fits must fall back, not blow up
+    params = dict(BASE, min_data_in_leaf=2, num_leaves=31)
+    b = _train(params, rounds=3, n=200)
+    preds = b.predict(_data(n=50)[0])
+    assert np.isfinite(preds).all()
+
+
+# ---------------------------------------------------------- engine parity
+def test_serial_equals_out_of_core_byte_identical():
+    s1 = _model_str(_train(BASE, rounds=6))
+    s2 = _model_str(_train(OOC, rounds=6))
+    assert s1 == s2
+
+
+def test_coeff_importance_parity_and_semantics():
+    b1 = _train(BASE, rounds=6)
+    b2 = _train(OOC, rounds=6)
+    i1 = b1.feature_importance(importance_type="coeff")
+    i2 = b2.feature_importance(importance_type="coeff")
+    assert np.array_equal(i1, i2)
+    assert i1.sum() > 0          # linear leaves actually fitted
+    # constant models have an all-zero coeff importance, and the other
+    # importance types still work on linear models
+    const = _train(dict(BASE, linear_tree=False), rounds=3)
+    assert const.feature_importance(importance_type="coeff").sum() == 0
+    assert b1.feature_importance(importance_type="gain").sum() > 0
+    with pytest.raises(LightGBMError, match="importance type"):
+        b1.feature_importance(importance_type="nope")
+
+
+def test_bagging_feature_fraction_multiclass_dart():
+    # satellite smoke: the fit composes with the sampling knobs and the
+    # other boosting modes; every prediction finite, models reload
+    for extra in ({"bagging_fraction": 0.7, "bagging_freq": 2,
+                   "feature_fraction": 0.6},
+                  {"objective": "multiclass", "num_class": 3},
+                  {"boosting_type": "dart", "drop_rate": 0.5}):
+        params = dict(BASE, **extra)
+        x, y = _data(n=800)
+        if extra.get("objective") == "multiclass":
+            y = (np.asarray(y, int) + (x[:, 2] > 0.5)).astype(np.float64)
+        b = lgb.train(dict(params),
+                      lgb.Dataset(x, y, params=dict(params)),
+                      num_boost_round=4, verbose_eval=False)
+        s = b.gbdt.save_model_to_string(-1)
+        assert np.isfinite(b.predict(x[:64])).all()
+        assert _load(s).save_model_to_string(-1) == s
+
+
+def test_linear_tree_rejects_parallel_learners():
+    x, y = _data(n=400)
+    params = dict(BASE, tree_learner="feature", num_machines=2)
+    with pytest.raises(LightGBMError, match="linear_tree"):
+        lgb.train(params, lgb.Dataset(x, y, params=params),
+                  num_boost_round=1, verbose_eval=False)
+
+
+# ----------------------------------------------------------- serialization
+def test_save_load_save_byte_identical():
+    s = _model_str(_train(BASE, rounds=5))
+    assert "format_version=2" in s.splitlines()[1]
+    assert _load(s).save_model_to_string(-1) == s
+
+
+def test_constant_model_stays_format_v1():
+    s = _model_str(_train(dict(BASE, linear_tree=False), rounds=3))
+    assert "format_version" not in s
+    assert _load(s).save_model_to_string(-1) == s
+
+
+def test_loader_rejects_newer_format_version():
+    s = _model_str(_train(BASE, rounds=2))
+    s99 = s.replace("format_version=2", "format_version=99", 1)
+    with pytest.raises(LightGBMError, match="format_version"):
+        GBDT().load_model_from_string(s99)
+
+
+def test_loader_rejects_linear_section_under_v1():
+    s = _model_str(_train(BASE, rounds=2))
+    lines = s.splitlines()
+    assert lines[1] == "format_version=2"
+    del lines[1]          # header claims v1, trees still carry coeffs
+    with pytest.raises(LightGBMError, match="format_version"):
+        GBDT().load_model_from_string("\n".join(lines))
+
+
+def test_loader_rejects_unknown_tree_section():
+    s = _model_str(_train(dict(BASE, linear_tree=False), rounds=2))
+    s_bad = s.replace("leaf_count=", "leaf_frobnication=7\nleaf_count=", 1)
+    with pytest.raises(LightGBMError, match="unknown section"):
+        GBDT().load_model_from_string(s_bad)
+
+
+# -------------------------------------------------------- fault tolerance
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear_faults()
+    yield
+    faults.clear_faults()
+
+
+def test_crash_resume_byte_identical(tmp_path):
+    """Kill training at iteration 8, resume from the iteration-5
+    checkpoint: the final model string must equal the uninterrupted
+    run's byte-for-byte — the checkpoint round-trips the linear-leaf
+    arrays AND the RNG state (bagging + feature_fraction active)."""
+    params = dict(BASE, bagging_fraction=0.7, bagging_freq=2,
+                  feature_fraction=0.6)
+    x, y = _data(n=1500)
+
+    def run(ckpt_dir=None, crash_at=None, resume=False):
+        cbs = ([callback.checkpoint(ckpt_dir, period=5)]
+               if ckpt_dir else [])
+        if crash_at is not None:
+            faults.set_fault("crash_at_iteration", crash_at)
+        try:
+            b = lgb.train(dict(params),
+                          lgb.Dataset(x, y, params=dict(params)),
+                          num_boost_round=12, verbose_eval=False,
+                          callbacks=cbs,
+                          resume_from=ckpt_dir if resume else None)
+        except faults.InjectedFault:
+            return None
+        finally:
+            faults.clear_faults()
+        return b.gbdt.save_model_to_string(-1)
+
+    ref = run()
+    d = str(tmp_path / "ck")
+    assert run(ckpt_dir=d, crash_at=8) is None
+    got = run(ckpt_dir=d, resume=True)
+    assert got == ref
+
+
+# ----------------------------------------------------------------- serving
+def test_serving_exact_bit_parity_with_host_including_nan():
+    b = _train(BASE, rounds=6)
+    x, _ = _data(n=500, seed=3)
+    x = x.astype(np.float32)          # f32-representable inputs
+    x[:40, 0] = np.nan                # NaN fallback rows
+    host_raw = b.gbdt.predict_raw(np.asarray(x, np.float64))
+    host_p = b.gbdt.predict(np.asarray(x, np.float64))
+    p = CompiledPredictor.from_booster(b, max_batch_rows=256)
+    assert p.describe()["is_linear"] is True
+    assert np.array_equal(p.predict_raw(x), host_raw)
+    assert np.array_equal(p.predict(x), host_p)
+    # the device f32 throughput variant stays close
+    assert np.abs(p.predict_raw_device(x) - host_raw).max() < 1e-4
+
+
+def test_serving_bf16_within_pinned_bound():
+    b = _train(BASE, rounds=6)
+    x, _ = _data(n=500, seed=3)
+    x = x.astype(np.float32)
+    host_raw = b.gbdt.predict_raw(np.asarray(x, np.float64))
+    host_p = b.gbdt.predict(np.asarray(x, np.float64))
+    p = CompiledPredictor.from_booster(b, max_batch_rows=256,
+                                       serving_precision="bf16")
+    assert p.accuracy_bound > 0
+    assert np.abs(p.predict_raw(x) - host_raw).max() <= p.accuracy_bound
+    assert np.abs(p.predict(x) - host_p).max() <= p.accuracy_bound
+    # coefficient rounding really contributes to the linear bound
+    pc = CompiledPredictor.from_booster(
+        _train(dict(BASE, linear_tree=False), rounds=6),
+        max_batch_rows=256, serving_precision="bf16")
+    assert p.accuracy_bound >= pc.accuracy_bound
+
+
+def test_serving_rejects_overwide_leaf_models():
+    b = _train(BASE, rounds=2)
+    wide = b.gbdt._stacked_linear_arrays(len(b.gbdt.models))
+    const, coef, cfeat, ccnt = wide
+    pad = 9 - coef.shape[2]
+    coef = np.pad(coef, ((0, 0), (0, 0), (0, pad)))
+    cfeat = np.pad(cfeat, ((0, 0), (0, 0), (0, pad)))
+    b.gbdt._stacked_linear_arrays = lambda n: (const, coef, cfeat, ccnt)
+    with pytest.raises(ValueError, match="COEF_PAD"):
+        CompiledPredictor.from_booster(b)
+
+
+def _post(url, rows):
+    req = urllib.request.Request(
+        url + "/predict",
+        data=json.dumps({"rows": np.asarray(rows).tolist()}).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+def test_hot_swap_linear_challenger_over_constant_incumbent(tmp_path):
+    """The day-one story: a constant incumbent serves traffic, a
+    linear-tree challenger promotes, the follower flips — zero 5xx,
+    zero cold dispatches, responses match exactly one model."""
+    registry = ModelRegistry(str(tmp_path / "registry"))
+    x, y = _data(n=1000)
+    probe = x[:16].astype(np.float32)
+    paths, boosters = [], []
+    for name, params in (("const", dict(BASE, linear_tree=False)),
+                         ("linear", BASE)):
+        b = lgb.train(dict(params),
+                      lgb.Dataset(x, y, params=dict(params)),
+                      num_boost_round=5, verbose_eval=False)
+        path = str(tmp_path / f"{name}.txt")
+        b.save_model(path)
+        paths.append(path)
+        boosters.append(b.gbdt)
+    v1, v2 = registry.publish(paths[0]), registry.publish(paths[1])
+    registry.promote(v1)
+    want = {1: boosters[0].predict(np.asarray(probe, np.float64)),
+            2: boosters[1].predict(np.asarray(probe, np.float64))}
+    assert np.abs(want[1] - want[2]).max() > 1e-5
+    pred = CompiledPredictor.from_model_file(registry.model_path(v1),
+                                             max_batch_rows=256)
+    srv = make_server(pred, port=0, max_wait_ms=1.0, model_version=v1)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    stop = threading.Event()
+    responses, errors = [], []
+
+    def client():
+        while not stop.is_set():
+            try:
+                responses.append(
+                    np.asarray(_post(url, probe)["predictions"]))
+            except Exception as e:   # noqa: BLE001 — any 5xx fails below
+                errors.append(repr(e))
+                return
+
+    workers = [threading.Thread(target=client) for _ in range(3)]
+    try:
+        for w in workers:
+            w.start()
+        time.sleep(0.3)
+        HotSwapper(srv, registry).swap_to(v2, reason="linear challenger")
+        time.sleep(0.3)
+        stop.set()
+        for w in workers:
+            w.join(timeout=30)
+        assert not errors, errors
+        n1 = n2 = 0
+        for out in responses:
+            if np.allclose(out.reshape(-1), want[1].reshape(-1),
+                           atol=1e-6):
+                n1 += 1
+            elif np.allclose(out.reshape(-1), want[2].reshape(-1),
+                             atol=1e-6):
+                n2 += 1
+            else:
+                raise AssertionError("mixed-version response")
+        assert n1 > 0 and n2 > 0
+        assert srv.predictor.stats["cold_dispatches"] == 0
+        assert srv.predictor.is_linear
+        final = np.asarray(_post(url, probe)["predictions"]).reshape(-1)
+        np.testing.assert_allclose(final, want[2].reshape(-1),
+                                   atol=1e-6, rtol=0)
+    finally:
+        stop.set()
+        srv.shutdown()
+        srv.server_close()
+        srv.batcher.close()

@@ -633,7 +633,96 @@ def check_router():
     return ok
 
 
+def check_linear():
+    """Linear-leaf acceptance guard (`make verify-linear`; bench
+    linear_probe in gate form, docs/Linear-Trees.md): (1) the sample-
+    efficiency win — the linear model reaches the constant baseline's
+    final AUC with <= VERIFY_LINEAR_MAX_TREES_RATIO (default 0.6) of
+    its trees OR beats it by >= VERIFY_LINEAR_MIN_AUC_DELTA (default
+    0.003) at equal trees; (2) the latency envelope — on the all-device
+    fused kernels (the apples-to-apples comparison) linear single-row
+    p99 stays within VERIFY_LINEAR_P99_FACTOR (default 1.3) of the
+    constant model's, and within VERIFY_LINEAR_TOL (default 50%) of
+    the committed linear_serving_p99_ms baseline; (3) zero cold
+    dispatches on every warmed predictor."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import bench
+    res = bench.linear_probe(
+        timeout_s=int(os.environ.get("VERIFY_LINEAR_TIMEOUT", "420")))
+    if "error" in res:
+        print(f"verify-linear: probe failed: {res['error']}")
+        return False
+    ok = True
+    print(f"verify-linear: const AUC {res['const_auc']:.5f} @ "
+          f"{res['trees']} trees; linear {res['linear_auc_at_equal_trees']:.5f}"
+          f" (delta {res['auc_delta_at_equal_trees']:+.5f}), matched at "
+          f"{res['trees_to_match_const']} trees "
+          f"(ratio {res['trees_at_equal_auc_ratio']:.3f})")
+    max_ratio = float(os.environ.get("VERIFY_LINEAR_MAX_TREES_RATIO",
+                                     "0.6"))
+    min_delta = float(os.environ.get("VERIFY_LINEAR_MIN_AUC_DELTA",
+                                     "0.003"))
+    tree_win = res["trees_at_equal_auc_ratio"] <= max_ratio
+    auc_win = res["auc_delta_at_equal_trees"] >= min_delta
+    if not (tree_win or auc_win):
+        print(f"verify-linear: neither win condition met (trees ratio "
+              f"{res['trees_at_equal_auc_ratio']:.3f} > {max_ratio}, "
+              f"AUC delta {res['auc_delta_at_equal_trees']:+.5f} < "
+              f"{min_delta}) -> LINEAR LEAVES BUY NOTHING")
+        ok = False
+    else:
+        wins = [w for w, hit in (("trees", tree_win), ("auc", auc_win))
+                if hit]
+        print(f"verify-linear: win condition(s) met: {', '.join(wins)} "
+              "-> OK")
+    factor = float(os.environ.get("VERIFY_LINEAR_P99_FACTOR", "1.3"))
+    ratio = res["serving_p99_ratio"]
+    print(f"verify-linear: fused-path p99 linear "
+          f"{res['linear_bf16_serving_p99_ms']:.3f} ms vs const "
+          f"{res['const_bf16_serving_p99_ms']:.3f} ms (ratio "
+          f"{ratio:.2f}, exact-path ratio "
+          f"{res['exact_serving_p99_ratio']:.2f})")
+    if ratio > factor:
+        print(f"verify-linear: fused p99 ratio {ratio:.2f} > "
+              f"{factor:.1f}x -> LINEAR KERNEL COSTS THE ENVELOPE")
+        ok = False
+    with open(BASELINE_PATH) as f:
+        base = json.load(f)
+    base_p99 = base.get("linear_serving_p99_ms")
+    if base_p99:
+        tol = float(os.environ.get("VERIFY_LINEAR_TOL", "0.50"))
+        limit = base_p99 * (1.0 + tol)
+        during = res["linear_bf16_serving_p99_ms"]
+        good = during <= limit
+        print(f"verify-linear: linear fused p99 {during:.3f} ms vs "
+              f"baseline {base_p99:.3f} ms (limit {limit:.3f} ms) -> "
+              f"{'OK' if good else 'REGRESSION'}")
+        ok = ok and good
+    else:
+        print("verify-linear: baseline has no linear_serving_p99_ms — "
+              "regression gate skipped (bump BENCH_BASELINE.json to "
+              "arm)")
+    colds = {k: v for k, v in res.items()
+             if k.endswith("_cold_dispatches") and v}
+    if colds:
+        print(f"verify-linear: cold dispatches after warmup: {colds} "
+              "-> NOT AOT-WARMED")
+        ok = False
+    else:
+        print("verify-linear: cold_dispatches 0 on every warmed "
+              "predictor -> OK")
+    return ok
+
+
 def main():
+    if "--linear" in sys.argv:
+        if not check_linear():
+            print("verify-linear: FAILED")
+            return 1
+        print("verify-linear: all checks passed")
+        return 0
     if "--router" in sys.argv:
         if not check_router():
             print("verify-router: FAILED")
