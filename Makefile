@@ -84,12 +84,20 @@ verify-serve:
 # spans/comm + a run_summary history record, exports the trace and
 # re-loads it through the event-invariant check), and the sentinel
 # self-check (a seeded clean history passes, an injected >20%
-# train-time regression trips)
+# train-time regression trips). The disttrace leg covers the
+# distributed-tracing layer end to end: header roundtrip, tail
+# sampling, the collector stitching a live router + 2-replica run
+# into one cross-process tree, Perfetto flow export through
+# validate_trace, and the flight recorder's blackbox dump — then the
+# acceptance guard (bench trace_probe via tools/verify_perf.py
+# --trace: serving p99 overhead with tracing on at the default
+# sample rate must stay under 1% / the CI noise slack vs tracing off)
 verify-obs:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
-	  tests/test_telemetry.py tests/test_comm_obs.py -q
+	  tests/test_telemetry.py tests/test_comm_obs.py tests/test_disttrace.py -q
 	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_journal.py --demo
 	env JAX_PLATFORMS=cpu $(PYTHON) tools/sentinel.py --self-check
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) tools/verify_perf.py --trace
 
 # perf guardrail: the scaled CPU rung (warm compile cache) must stay
 # within 15% of the committed BENCH_BASELINE.json train time at an AUC

@@ -827,6 +827,139 @@ def serving_probe(booster, x):
     return out
 
 
+def trace_probe(timeout_s=300):
+    """Distributed-tracing overhead probe (docs/Observability.md):
+    two identical in-process serving replicas — one with tracing OFF,
+    one with the full trace pipeline ON at the DEFAULT sample rate
+    (trace_sample_rate=0.01, journal-backed recorder + flight
+    recorder armed) — take the same single-row HTTP traffic in
+    interleaved windows (order alternates per round so clock drift
+    and allocator warmup cancel). Reports pooled p50/p99 per arm and
+    `overhead_pct` = (p99_on - p99_off) / p99_off; tools/verify_perf.py
+    --trace gates it under VERIFY_TRACE_OVERHEAD_PCT (default 1%, with
+    an absolute noise slack for the 1-core CI rung)."""
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import CompiledPredictor, make_server
+    from lightgbm_tpu.telemetry import disttrace
+
+    out = {}
+    servers = []
+    deadline = time.time() + timeout_s
+    tdir = tempfile.mkdtemp(prefix="lgbm_trace_probe_")
+    try:
+        n = int(os.environ.get("BENCH_TRACE_ROWS", "4000"))
+        x, y = make_data(n)
+        params = {"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 20, "verbose": -1}
+        _mark(f"trace probe: training serving model ({n} rows)")
+        booster = lgb.train(dict(params),
+                            lgb.Dataset(x, y, params=dict(params)),
+                            num_boost_round=5, verbose_eval=False)
+
+        def spin(**kw):
+            pred = CompiledPredictor.from_booster(booster.gbdt,
+                                                  max_batch_rows=256)
+            srv = make_server(pred, port=0, max_wait_ms=1.0, **kw)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            servers.append(srv)
+            return f"http://127.0.0.1:{srv.server_address[1]}/predict"
+
+        url_off = spin()
+        url_on = spin(trace_dir=tdir, trace_rank=0,
+                      trace_sample_rate=disttrace.DEFAULT_SAMPLE_RATE)
+        body = json.dumps(
+            {"rows": np.ascontiguousarray(x[:1],
+                                          dtype=np.float32).tolist()}
+        ).encode()
+
+        def one(url):
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.monotonic()
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                r.read()
+            return time.monotonic() - t0
+
+        for url in (url_off, url_on):   # first-touch outside timing
+            for _ in range(20):
+                one(url)
+        rounds = int(os.environ.get("BENCH_TRACE_ROUNDS", "8"))
+        per_window = int(os.environ.get("BENCH_TRACE_WINDOW", "80"))
+        lats = {url_off: [], url_on: []}
+        round_lats = {url_off: [], url_on: []}   # per-round windows
+        _mark(f"trace probe: {rounds} interleaved rounds x "
+              f"{per_window} req/arm (sample rate "
+              f"{disttrace.DEFAULT_SAMPLE_RATE})")
+        for rnd in range(rounds):
+            if time.time() > deadline:
+                break
+            order = ((url_off, url_on) if rnd % 2 == 0
+                     else (url_on, url_off))
+            for url in order:
+                window = [one(url) for _ in range(per_window)]
+                round_lats[url].append(window)
+                lats[url].extend(window)
+        from lightgbm_tpu.telemetry.registry import nearest_rank
+        for label, url in (("off", url_off), ("on", url_on)):
+            arm = sorted(lats[url])
+            out[f"p50_{label}_ms"] = round(
+                nearest_rank(arm, 50) * 1e3, 4)
+            out[f"p99_{label}_ms"] = round(
+                nearest_rank(arm, 99) * 1e3, 4)
+        out["samples_per_arm"] = len(lats[url_off])
+        out["sample_rate"] = disttrace.DEFAULT_SAMPLE_RATE
+        out["overhead_pct"] = round(
+            100.0 * (out["p99_on_ms"] - out["p99_off_ms"])
+            / max(out["p99_off_ms"], 1e-9), 3)
+        # pooled p99 is hostage to whichever arm a scheduler hiccup
+        # lands in; the GATED statistic is the median over rounds of
+        # the per-round p99 delta — a hiccup inflates one round, the
+        # median ignores it (tools/verify_perf.py --trace)
+        deltas = sorted(
+            nearest_rank(sorted(on_w), 99) - nearest_rank(
+                sorted(off_w), 99)
+            for off_w, on_w in zip(round_lats[url_off],
+                                   round_lats[url_on]))
+        if deltas:
+            out["p99_delta_median_ms"] = round(
+                deltas[len(deltas) // 2] * 1e3, 4)
+            out["p50_delta_median_ms"] = round(sorted(
+                nearest_rank(sorted(on_w), 50) - nearest_rank(
+                    sorted(off_w), 50)
+                for off_w, on_w in zip(round_lats[url_off],
+                                       round_lats[url_on])
+            )[len(deltas) // 2] * 1e3, 4)
+        # the traced arm must actually have SEEN traces — an
+        # accidentally-disabled recorder would gate 0% forever (kept
+        # count stays near sample_rate x traffic by design)
+        st = servers[-1].trace_recorder.stats()
+        out["trace_spans_recorded"] = st["trace_spans_recorded"]
+        out["traces_seen"] = st["traces_kept"] + st["traces_dropped"]
+    except Exception as e:  # a probe must never cost the result
+        _mark(f"trace probe failed: {e}")
+        out["error"] = str(e)[-250:]
+    finally:
+        for srv in servers:
+            try:
+                srv.shutdown()
+                srv.server_close()
+                srv.batcher.close()
+                if getattr(srv, "trace_recorder", None) is not None:
+                    srv.trace_recorder.close()
+            except Exception:
+                pass
+        disttrace.FLIGHT.disarm()
+        shutil.rmtree(tdir, ignore_errors=True)
+    return out
+
+
 def linear_probe(timeout_s=420):
     """Linear-leaf acceptance probe (docs/Linear-Trees.md): on a
     piece-wise linear synthetic task, train a constant-leaf baseline
@@ -2254,6 +2387,10 @@ def main():
     if "router_probe" in sys.argv:
         # standalone front-door chaos probe: `python bench.py router_probe`
         print(json.dumps({"router": router_probe()}), flush=True)
+        return
+    if "trace_probe" in sys.argv:
+        # standalone tracing-overhead probe: `python bench.py trace_probe`
+        print(json.dumps({"trace": trace_probe()}), flush=True)
         return
     if "--child" in sys.argv:
         run_child()

@@ -5,4 +5,4 @@ docs/Static-Analysis.md "Adding a rule"."""
 
 from . import (atomic_writes, callback_mesh, collectives, config_doc,
                determinism, journal_schema, precision,
-               prom_naming, unbounded_io)  # noqa: F401
+               prom_naming, trace_context, unbounded_io)  # noqa: F401

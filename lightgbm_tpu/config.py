@@ -313,6 +313,19 @@ class Config:
     # (telemetry/history.py; `tools/sentinel.py` trends over the last K
     # records and verify-perf gates on it); "" = off
     run_history: str = ""
+    # distributed request tracing (telemetry/disttrace.py): the
+    # deterministic hash(trace_id) fraction of healthy traces kept by
+    # the tail sampler; error/504/shed and slow-over-slow_request_ms
+    # traces are ALWAYS kept regardless (docs/Observability.md)
+    trace_sample_rate: float = 0.01
+    # keep ONLY error/slow traces: drops even the hash-sampled healthy
+    # fraction (the lowest-overhead setting that still catches every
+    # incident trace)
+    trace_slow_only: bool = False
+    # crash flight recorder: dump the span ring + registry snapshot +
+    # journal tail to <telemetry_dir>/blackbox-<rank>.json on watchdog
+    # abort (exit 117/118), SIGQUIT and unhandled serving exceptions
+    blackbox: bool = True
     # documented default port for the fleet aggregator CLI
     # (`python -m lightgbm_tpu.telemetry.aggregate --port`); multi-rank
     # CLI runs offset `telemetry_port` by rank so every rank of a

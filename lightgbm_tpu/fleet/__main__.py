@@ -130,6 +130,24 @@ def main(argv=None):
                    help="hard cap on any single upstream call")
     p.add_argument("--health-poll-s", type=float, default=0.5,
                    help="strict /healthz probe interval")
+    p.add_argument("--trace-dir", default=None,
+                   help="arm distributed tracing: journal tail-sampled "
+                        "trace records here (telemetry/disttrace.py, "
+                        "docs/Observability.md)")
+    p.add_argument("--trace-rank", type=int, default=0,
+                   help="journal rank suffix for this router's trace "
+                        "records (keep distinct from the replicas "
+                        "sharing --trace-dir)")
+    p.add_argument("--trace-sample-rate", type=float, default=0.01,
+                   help="deterministic hash(trace_id) fraction of "
+                        "non-error, non-slow traces to keep (mirrors "
+                        "the trace_sample_rate config knob)")
+    p.add_argument("--trace-slow-only", action="store_true",
+                   help="drop even hash-sampled healthy traces; keep "
+                        "only error/slow ones (mirrors trace_slow_only)")
+    p.add_argument("--trace-slow-ms", type=float, default=1000.0,
+                   help="traces spanning longer than this are always "
+                        "kept (mirrors slow_request_ms)")
 
     p = common(sub.add_parser(
         "watch", help="drift -> retrain -> validate -> promote loop"))

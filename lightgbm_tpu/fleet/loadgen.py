@@ -28,6 +28,8 @@ import urllib.request
 
 import numpy as np
 
+from ..telemetry import disttrace
+
 
 class LoadGenerator:
     """Drive `POST <url>/predict` at `qps` requests/s with `workers`
@@ -37,7 +39,7 @@ class LoadGenerator:
 
     def __init__(self, url, row_batches, qps=100.0, workers=4,
                  duration_s=5.0, timeout_s=30.0, path="/predict",
-                 deadline_ms=None):
+                 deadline_ms=None, trace=False):
         self.url = url.rstrip("/") + path
         self.bodies = [json.dumps({"rows": np.asarray(b).tolist()})
                        .encode() for b in row_batches]
@@ -49,6 +51,12 @@ class LoadGenerator:
         # carries `X-Deadline-Ms: deadline_ms` so the serving side can
         # deadline-drop/shed; None = header omitted (legacy behavior)
         self.deadline_ms = deadline_ms
+        # trace=True makes the generator the TRACE HEAD: each request
+        # carries a fresh sampled X-Trace-Ctx so the whole synthetic
+        # flow shows up on /tracez (docs/Observability.md); trace=False
+        # still routes headers through inject_headers, which passes
+        # them through unstamped when no context is active
+        self.trace = bool(trace)
         self.samples = []      # (t_start_rel, latency_s, ok)
         self.responses = []    # (t_start_rel, predictions) when kept
         self.errors = []       # repr strings, bounded
@@ -83,6 +91,11 @@ class LoadGenerator:
             headers = {"Content-Type": "application/json"}
             if self.deadline_ms is not None:
                 headers["X-Deadline-Ms"] = str(float(self.deadline_ms))
+            ctx = (disttrace.TraceContext(disttrace.new_trace_id(),
+                                          disttrace.new_span_id(),
+                                          flags=disttrace.FLAG_SAMPLED)
+                   if self.trace else None)
+            headers = disttrace.inject_headers(headers, ctx=ctx)
             try:
                 req = urllib.request.Request(
                     self.url, data=body, headers=headers)

@@ -58,9 +58,11 @@ import queue
 import random
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+from ..telemetry import disttrace
 from ..telemetry import prometheus
 from ..telemetry.registry import MetricsRegistry
 from ..utils.log import Log
@@ -111,10 +113,14 @@ class Router:
     def __init__(self, targets, breaker_failures=5, breaker_reset_s=1.0,
                  retry_budget=0.1, hedge_quantile=0.0,
                  upstream_timeout_s=10.0, health_poll_s=0.5,
-                 retry_jitter_ms=5.0):
+                 retry_jitter_ms=5.0, trace_recorder=None):
         if not targets:
             raise ValueError("router needs at least one target")
         self.replicas = [Replica(t) for t in targets]
+        # distributed tracing (telemetry/disttrace.py): the router owns
+        # every trace's ROOT span; a NOOP recorder keeps the hot path
+        # branch-free when tracing is off
+        self.trace = trace_recorder or disttrace.NOOP_RECORDER
         self.breaker_failures = max(1, int(breaker_failures))
         self.breaker_reset_s = float(breaker_reset_s)
         self.retry_budget = float(retry_budget)
@@ -141,6 +147,14 @@ class Router:
         self._errors = reg.counter("error_count")
         self._deadline_expired = reg.counter("deadline_expired_count")
         self._latency = reg.histogram("latency_ms")
+        # per-replica upstream latency: what the hedger aims at, now
+        # exposed as p50/p99 gauges so hedge-threshold tuning is
+        # observable instead of blind
+        self._rep_latency = [
+            reg.histogram(f"replica_{i}_upstream_latency_ms")
+            for i in range(len(self.replicas))]
+        self._rep_index = {rep.target: i
+                           for i, rep in enumerate(self.replicas)}
         self.started_at = time.time()
         self._stop = threading.Event()
         self._health_thread = None
@@ -261,21 +275,33 @@ class Router:
 
     # ------------------------------------------------------------- proxying
     def _proxy_once(self, rep, path, body, headers, timeout_s,
-                    conn_box=None):
+                    conn_box=None, span=None):
         """One upstream attempt. Returns (status, resp_headers, data);
         raises OSError-family on transport failure. `conn_box` lets a
-        hedging race close this connection from outside (cancel)."""
+        hedging race close this connection from outside (cancel);
+        `span` is this attempt's trace span — its context is what the
+        replica continues (the attempt, not the root, is the upstream
+        hop's parent)."""
         self._attempts.inc()
+        headers = disttrace.inject_headers(
+            headers, ctx=span.context() if span is not None else None)
         conn = http.client.HTTPConnection(rep.host, rep.port,
                                           timeout=timeout_s)
         if conn_box is not None:
             conn_box.append(conn)
         with self._lock:
             rep.in_flight += 1
+        t_up = time.monotonic()
         try:
             conn.request("POST", path, body=body, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
+            idx = self._rep_index.get(rep.target)
+            if idx is not None:
+                self._rep_latency[idx].observe(
+                    (time.monotonic() - t_up) * 1e3)
+            # echo the replica's story to the caller: timing + ids
+            # survive the proxy hop instead of dying at the router
             keep = {k: v for k, v in resp.getheaders()
                     if k.lower() in ("content-type", "retry-after",
                                      "x-request-id", "x-timing-ms")}
@@ -310,37 +336,71 @@ class Router:
         ms = self._latency.percentiles((pct,)).get(pct)
         return None if ms is None else ms / 1e3
 
-    def _attempt(self, rep, path, body, headers, deadline_abs):
+    def _finish_attempt(self, span, status, err, cancelled=False):
+        """Close one attempt span with the outcome the trace reader
+        needs: ok / error (transport or retryable 5xx) / cancelled
+        (hedge loser whose socket the winner tore down)."""
+        if span is None:
+            return
+        if cancelled:
+            st = "cancelled"
+        elif err is not None or status in RETRYABLE_STATUSES:
+            st = "error"
+        else:
+            st = "ok"
+        tags = {}
+        if status is not None:
+            tags["http.status"] = int(status)
+        if err is not None:
+            tags["error"] = str(err)[:200]
+        self.trace.finish(span, status=st, **tags)
+
+    def _attempt(self, rep, path, body, headers, deadline_abs,
+                 root_ctx=None, attempt_no=0):
         """One attempt with optional hedging. Returns
         (status, headers, data, error, rep_that_answered)."""
         timeout_s = self._attempt_timeout(deadline_abs)
         up_headers = self._upstream_headers(headers, deadline_abs)
         hedge_delay = self._hedge_delay_s()
         if hedge_delay is None:
+            span = self.trace.start(
+                "router.attempt", ctx=root_ctx, kind="client",
+                tags={"replica": rep.target, "attempt": attempt_no})
             try:
                 status, rh, data = self._proxy_once(
-                    rep, path, body, up_headers, timeout_s)
+                    rep, path, body, up_headers, timeout_s, span=span)
+                self._finish_attempt(span, status, None)
                 return status, rh, data, None, rep
             except OSError as e:
+                self._finish_attempt(span, None, e)
                 return None, {}, b"", e, rep
 
         results = queue.Queue()
-        races = []    # [(replica, [conns])]
+        races = []    # [{rep, conns, span, cancelled}]
 
-        def run(target_rep):
-            box = []
-            races.append((target_rep, box))
+        def run(target_rep, hedged):
+            entry = {"rep": target_rep, "conns": [], "cancelled": False}
+            entry["span"] = self.trace.start(
+                "router.attempt", ctx=root_ctx, kind="client",
+                tags={"replica": target_rep.target,
+                      "attempt": attempt_no, "hedge": hedged})
+            races.append(entry)
             try:
-                results.put((target_rep,)
-                            + self._proxy_once(target_rep, path, body,
-                                               self._upstream_headers(
-                                                   headers, deadline_abs),
-                                               timeout_s, conn_box=box)
-                            + (None,))
+                status, rh, data = self._proxy_once(
+                    target_rep, path, body,
+                    self._upstream_headers(headers, deadline_abs),
+                    timeout_s, conn_box=entry["conns"],
+                    span=entry["span"])
+                self._finish_attempt(entry["span"], status, None,
+                                     cancelled=entry["cancelled"])
+                results.put((target_rep, status, rh, data, None))
             except OSError as e:
+                self._finish_attempt(entry["span"], None, e,
+                                     cancelled=entry["cancelled"])
                 results.put((target_rep, None, {}, b"", e))
 
-        threading.Thread(target=run, args=(rep,), daemon=True).start()
+        threading.Thread(target=run, args=(rep, False),
+                         daemon=True).start()
         launched = 1
         try:
             # primary answered (or failed fast) inside the hedge delay:
@@ -353,7 +413,7 @@ class Router:
         second = self.pick(exclude=(rep,))
         if second is not None and self._take_retry_token():
             self._hedges.inc()
-            threading.Thread(target=run, args=(second,),
+            threading.Thread(target=run, args=(second, True),
                              daemon=True).start()
             launched = 2
         best = None
@@ -365,10 +425,13 @@ class Router:
             won, status, rh, data, err = out
             if err is None and status not in RETRYABLE_STATUSES:
                 # first good answer wins: abort the loser's socket so
-                # no orphan result is ever written to the client
-                for racer_rep, box in races:
-                    if racer_rep is not won:
-                        for c in box:
+                # no orphan result is ever written to the client. The
+                # cancelled flag flips FIRST so the loser thread's
+                # span closes as "cancelled", not "error"
+                for entry in races:
+                    if entry["rep"] is not won:
+                        entry["cancelled"] = True
+                        for c in entry["conns"]:
                             try:
                                 c.close()
                             except OSError:
@@ -384,10 +447,33 @@ class Router:
 
     def dispatch(self, path, body, headers):
         """Route one client predict: pick -> attempt -> (budgeted)
-        retries. Returns (status, headers, data)."""
+        retries, under one trace root span. Returns
+        (status, headers, data)."""
         t0 = time.monotonic()
+        # continue the client's trace (X-Trace-Ctx) or root a new one;
+        # the head sampling decision made here propagates to every hop
+        ctx = disttrace.parse_header(
+            headers.get(disttrace.TRACE_HEADER) or "")
+        root = self.trace.start("router.request", ctx=ctx, kind="server",
+                                tags={"component": "router",
+                                      "path": path})
+        try:
+            status, rh, data = self._dispatch(
+                path, body, headers, root, t0)
+        except BaseException:
+            self.trace.finish(root, status="error",
+                              elapsed=time.monotonic() - t0)
+            raise
+        root.set_tag("http.status", int(status))
+        self.trace.finish(
+            root, status="error" if status >= 500 else "ok",
+            elapsed=time.monotonic() - t0)
+        return status, rh, data
+
+    def _dispatch(self, path, body, headers, root, t0):
         self._requests.inc()
         self._grant_request_budget()
+        root_ctx = root.context() if root is not None else None
         deadline_abs = None
         dl = headers.get("X-Deadline-Ms")
         if dl is not None:
@@ -396,12 +482,14 @@ class Router:
             except ValueError:
                 deadline_abs = None
         tried = set()
+        attempt_no = 0
         last = (502, {}, json.dumps(
             {"error": "no upstream attempt"}).encode())
         while True:
             if deadline_abs is not None \
                     and deadline_abs <= time.monotonic():
                 self._deadline_expired.inc()
+                root.set_tag("decision", "deadline_expired")
                 return 504, {}, json.dumps(
                     {"error": "deadline expired at router"}).encode()
             rep = self.pick(exclude=tried)
@@ -409,18 +497,24 @@ class Router:
                 if not tried:
                     self._no_replica.inc()
                     self._errors.inc()
+                    root.set_tag("decision", "no_healthy_replica")
                     return 503, {"Retry-After": "1"}, json.dumps(
                         {"error": "no healthy replica"}).encode()
                 self._errors.inc()
+                root.set_tag("decision", "replicas_exhausted")
                 return last
+            attempt_no += 1
             status, rh, data, err, won = self._attempt(
-                rep, path, body, headers, deadline_abs)
+                rep, path, body, headers, deadline_abs,
+                root_ctx=root_ctx, attempt_no=attempt_no)
             # the answering replica's breaker gets the credit/blame —
             # when a hedge won, the slow primary is not a "failure"
             failed = err is not None or status in RETRYABLE_STATUSES
             (self.on_failure if failed else self.on_success)(won)
             if not failed:
                 self._latency.observe((time.monotonic() - t0) * 1e3)
+                if attempt_no > 1:
+                    root.set_tag("retries", attempt_no - 1)
                 return status, rh, data
             last = (status if status is not None else 502,
                     rh, data or json.dumps(
@@ -428,6 +522,8 @@ class Router:
             tried.add(rep)
             if not self._take_retry_token():
                 self._errors.inc()
+                root.set_tag("decision", "retry_budget_exhausted")
+                root.set_tag("retries", attempt_no - 1)
                 return last
             self._retries.inc()
             # seeded jitter de-synchronizes retry stampedes
@@ -458,6 +554,9 @@ class Router:
                 "latency_p99_ms": round(pct.get(99, 0.0), 4),
                 "latency_window": self._latency.window,
             }
+        # per-replica upstream quantiles (the hedger's own aim data)
+        with self.registry.lock:
+            rep_pct = [h.percentiles((50, 99)) for h in self._rep_latency]
         with self._lock:
             snap["replica_count"] = len(self.replicas)
             snap["healthy_replica_count"] = sum(
@@ -466,8 +565,12 @@ class Router:
             snap["replicas"] = [
                 {"target": r.target, "in_flight": r.in_flight,
                  "breaker": r.breaker, "ejected": r.ejected,
-                 "consecutive_failures": r.consecutive_failures}
-                for r in self.replicas]
+                 "consecutive_failures": r.consecutive_failures,
+                 "upstream_latency_p50_ms": round(
+                     rep_pct[i].get(50, 0.0), 4),
+                 "upstream_latency_p99_ms": round(
+                     rep_pct[i].get(99, 0.0), 4)}
+                for i, r in enumerate(self.replicas)]
         return snap
 
     def prometheus(self):
@@ -481,6 +584,11 @@ class Router:
                 extra[f"replica_{i}_breaker_state"] = \
                     _BREAKER_CODE[rep.breaker]
                 extra[f"replica_{i}_ejected"] = int(rep.ejected)
+        for i, entry in enumerate(snap.get("replicas", ())):
+            extra[f"replica_{i}_upstream_latency_p50_ms"] = \
+                entry["upstream_latency_p50_ms"]
+            extra[f"replica_{i}_upstream_latency_p99_ms"] = \
+                entry["upstream_latency_p99_ms"]
         return prometheus.render(self.registry.snapshot(),
                                  extra_gauges=extra)
 
@@ -545,9 +653,19 @@ class RouterHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(length) if length > 0 else b""
         fwd = {k: v for k, v in self.headers.items()
                if k.lower() in ("content-type", "x-request-id",
-                                "x-deadline-ms")}
+                                "x-deadline-ms", "x-trace-ctx")}
+        # the front door MINTS the request id when the client didn't:
+        # every upstream hop and every reply — including router-local
+        # 503/504s — carries one id the whole story keys on
+        rid = next((v for k, v in fwd.items()
+                    if k.lower() == "x-request-id"), None)
+        if rid is None:
+            rid = uuid.uuid4().hex[:16]
+            fwd["X-Request-Id"] = rid
         fwd["Content-Length"] = str(len(body))
         status, rh, data = self.router.dispatch(path, body, fwd)
+        rh = dict(rh)
+        rh.setdefault("X-Request-Id", rid)
         self._reply(status, data, rh)
 
 
@@ -555,11 +673,23 @@ class RouterHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
 
-def make_router_server(targets, host="127.0.0.1", port=8800, **knobs):
+def make_router_server(targets, host="127.0.0.1", port=8800,
+                       trace_dir=None, trace_rank=0,
+                       trace_sample_rate=disttrace.DEFAULT_SAMPLE_RATE,
+                       trace_slow_only=False, trace_slow_ms=1000.0,
+                       **knobs):
     """Router + bound handler + ThreadingHTTPServer (not yet serving).
     `knobs` are Router() kwargs. Starts the health loop; the caller
-    owns serve_forever and shutdown (srv.router.stop() on teardown)."""
-    router = Router(targets, **knobs)
+    owns serve_forever and shutdown (srv.router.stop() on teardown).
+    `trace_dir` arms distributed tracing: completed spans journal
+    there (tail-sampled) for the aggregator's collector to stitch."""
+    recorder = None
+    if trace_dir:
+        recorder = disttrace.TraceRecorder(
+            directory=trace_dir, rank=trace_rank, service="router",
+            sample_rate=trace_sample_rate, slow_ms=trace_slow_ms,
+            slow_only=trace_slow_only)
+    router = Router(targets, trace_recorder=recorder, **knobs)
     handler = type("BoundRouterHandler", (RouterHandler,),
                    {"router": router})
     srv = RouterHTTPServer((host, port), handler)
@@ -580,7 +710,13 @@ def main(args):
         retry_budget=args.retry_budget,
         hedge_quantile=args.hedge_quantile,
         upstream_timeout_s=args.upstream_timeout_s,
-        health_poll_s=args.health_poll_s)
+        health_poll_s=args.health_poll_s,
+        trace_dir=getattr(args, "trace_dir", None),
+        trace_rank=getattr(args, "trace_rank", 0),
+        trace_sample_rate=getattr(args, "trace_sample_rate",
+                                  disttrace.DEFAULT_SAMPLE_RATE),
+        trace_slow_only=getattr(args, "trace_slow_only", False),
+        trace_slow_ms=getattr(args, "trace_slow_ms", 1000.0))
     Log.info("router fronting %d replica(s): %s", len(targets),
              ", ".join(targets))
     # the driver-facing readiness line (same contract as SERVING)
@@ -592,5 +728,7 @@ def main(args):
         pass
     finally:
         srv.router.stop()
+        if srv.router.trace is not disttrace.NOOP_RECORDER:
+            srv.router.trace.close()
         srv.server_close()
     return 0

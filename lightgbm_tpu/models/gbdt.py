@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..parallel import heartbeat
+from ..telemetry import disttrace
 from ..telemetry import journal as run_journal
 from ..telemetry.registry import MetricsRegistry
 from ..telemetry.trace import SpanTracer
@@ -540,6 +541,38 @@ class GBDT:
                                                    1) or 1)})
                 run_journal.set_current(self.journal)
                 self.tracer.rank = rank
+                # distributed tracing (telemetry/disttrace.py): the
+                # process-default recorder shares the run journal, so
+                # traced canary retrains (LGBM_TPU_TRACE_CTX from a
+                # /fleetz-driven comparison) land `trace` records in
+                # the same timeline; SpanTracer mirrors its spans into
+                # any active context via this recorder
+                self._trace_recorder = disttrace.configure(
+                    journal=self.journal, rank=rank, service="train",
+                    sample_rate=float(getattr(config,
+                                              "trace_sample_rate",
+                                              0.01) or 0.0),
+                    slow_ms=float(getattr(config, "slow_request_ms",
+                                          0.0) or 0.0),
+                    slow_only=bool(getattr(config, "trace_slow_only",
+                                           False)))
+                # crash flight recorder (`blackbox` knob): ring +
+                # registry + journal tail dumped on watchdog abort
+                # (exit 117/118, parallel/heartbeat.py), SIGQUIT, and
+                # unhandled serving exceptions
+                if getattr(config, "blackbox", True):
+                    flight = disttrace.FLIGHT.configure(directory,
+                                                        rank=rank)
+                    self._flight_armed = flight.enabled
+                    tracer, metrics = self.tracer, self.metrics
+                    jpath = self.journal.path
+                    flight.add_source("spans",
+                                      lambda: tracer.recent(None))
+                    flight.add_source("metrics", metrics.snapshot)
+                    flight.add_source(
+                        "journal_tail",
+                        lambda: run_journal.tail(jpath, n=20))
+                    flight.install_sigquit()
         port = int(getattr(config, "telemetry_port", 0) or 0)
         if port > 0 and self._trainz_server is None:
             from ..telemetry import trainz
@@ -678,6 +711,18 @@ class GBDT:
         rank-0 merge) and stop the /trainz thread. Safe to call twice."""
         if self.journal is not None:
             self.finalize_introspection()
+            # retire OUR trace recorder first: it shares the journal,
+            # so its pending fragments must flush before close. A
+            # newer booster's recorder stays installed
+            rec = getattr(self, "_trace_recorder", None)
+            if rec is not None:
+                rec.flush_pending()
+                if disttrace.get_recorder() is rec:
+                    disttrace.set_recorder(None)
+                self._trace_recorder = None
+            if getattr(self, "_flight_armed", False):
+                disttrace.FLIGHT.disarm()
+                self._flight_armed = False
             if merge:
                 run_journal.merge_journals(self.journal.directory)
             self.journal.close()

@@ -131,6 +131,14 @@ class CollectiveWatchdog:
                 {"rank": self.rank, "collective": name,
                  "iteration": iteration, "timeout_s": self.timeout_s,
                  "time": time.time()})
+        # flight recorder FIRST (telemetry/disttrace.py): the span
+        # ring + registry snapshot are in-memory only — this is the
+        # last chance to land them on disk before os._exit. Naming the
+        # hung collective makes the blackbox a self-contained
+        # post-mortem
+        _flight_dump("collective_watchdog", collective=name,
+                     iteration=int(iteration),
+                     timeout_s=self.timeout_s)
         # the abort lands in the run journal's timeline (exit 117 and
         # the later restart/resume tell one story; telemetry/journal.py)
         _journal_abort(EXIT_WATCHDOG, "collective_watchdog",
@@ -315,6 +323,8 @@ class HeartbeatService:
                 "state: %s",
                 dead, ", ".join(f"{ages[r]:.1f}s" for r in dead),
                 self.timeout_s, report or "n/a")
+            _flight_dump("peer_lost",
+                         dead_ranks=[int(r) for r in dead])
             _journal_abort(EXIT_PEER_LOST, "peer_lost",
                            dead_ranks=[int(r) for r in dead])
             if self.on_peer_lost is not None:
@@ -383,6 +393,17 @@ def bind_beat_extra(fn):
     peers/aggregators can compute straggler deltas); None unbinds."""
     global _BEAT_EXTRA
     _BEAT_EXTRA = fn
+
+
+def _flight_dump(reason, **fields):
+    """Best-effort blackbox dump (telemetry/disttrace.py FLIGHT) from
+    an abort path. Same never-raise discipline as _journal_abort: the
+    dump is evidence, the abort must proceed regardless."""
+    try:
+        from ..telemetry import disttrace
+        disttrace.FLIGHT.dump(reason, **fields)
+    except Exception:   # evidence collection must never mask the abort
+        pass
 
 
 def _journal_abort(exit_code, reason, **fields):

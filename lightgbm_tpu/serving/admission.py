@@ -133,6 +133,15 @@ class AdmissionController:
             self.metrics.record_shed()
         return "shed", retry_after
 
+    def trace_tags(self):
+        """The controller's state as span tags (telemetry/disttrace.py):
+        WHY a request was shed or browned out, readable straight off
+        the /tracez per-hop breakdown."""
+        return {"estimated_wait_ms": round(self.estimated_wait_s() * 1e3,
+                                           3),
+                "queue_depth": int(self.batcher.queue_depth()),
+                "brownout": bool(self._brownout)}
+
     def _update_brownout(self, pressure):
         with self._lock:
             if not self._brownout and pressure >= BROWNOUT_ENGAGE:

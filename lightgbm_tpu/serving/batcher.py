@@ -21,6 +21,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..telemetry import disttrace
 from ..utils import faults
 
 KINDS = ("predict", "raw", "leaf")
@@ -52,6 +53,10 @@ class MicroBatcher:
         # serving server shares its dict here so `wedge_batcher` can
         # target one in-process replica
         self.chaos = None
+        # distributed tracing (telemetry/disttrace.py): set by
+        # make_server; the worker emits batch-dispatch + kernel spans
+        # onto the first member's trace, linking the other members
+        self.trace_recorder = None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue = []    # [(kind, rows, future, t_enqueue, deadline)]
@@ -85,6 +90,9 @@ class MicroBatcher:
         # the future, so a woken waiter always sees all three
         fut.t_enqueue = time.monotonic()
         fut.t_dispatch = fut.t_done = fut.scored_by = None
+        # the submitting thread's trace context rides the future into
+        # the worker: the batch span knows every member it coalesced
+        fut.trace_ctx = disttrace.current()
         with self._cond:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
@@ -188,6 +196,35 @@ class MicroBatcher:
             return kind, []
         return kind, batch
 
+    def _emit_trace(self, kind, batch, w_dispatch, dispatch_s,
+                    kernel_offset_s, kernel_s, total_rows, status):
+        """Batch-dispatch + kernel spans for one coalesced dispatch.
+        They attach to the FIRST traced member's trace; every other
+        member's trace_id is carried in `links` so the collector can
+        stitch the shared dispatch into all of them. Emitted BEFORE
+        the futures resolve, while the member roots are still open."""
+        rec = self.trace_recorder
+        if rec is None or not rec.enabled:
+            return
+        ctxs = [f.trace_ctx for _, f in batch
+                if getattr(f, "trace_ctx", None) is not None]
+        if not ctxs:
+            return
+        head = ctxs[0]
+        links = sorted({c.trace_id for c in ctxs[1:]
+                        if c.trace_id != head.trace_id}) or None
+        span = rec.observe(
+            "batch.dispatch", head, w_dispatch, dispatch_s,
+            status=status, links=links,
+            tags={"kind": kind, "rows": int(total_rows),
+                  "requests": len(batch)})
+        if kernel_s is not None:
+            rec.observe("serve.kernel", head,
+                        w_dispatch + kernel_offset_s, kernel_s,
+                        status=status,
+                        parent=span.span_id if span is not None
+                        else None)
+
     def _run(self):
         while True:
             got = self._take_batch()
@@ -201,6 +238,8 @@ class MicroBatcher:
             # a coalesced dispatch is scored entirely by one model
             pred = self.predictor
             t_dispatch = time.monotonic()
+            w_dispatch = time.time()
+            t_k0 = t_k1 = None
             try:
                 # inside the try: ANY failure (even a concat shape
                 # mismatch) must fail this batch's futures, never kill
@@ -215,17 +254,23 @@ class MicroBatcher:
                     if canon is not None:
                         parts = [canon(r) for r in parts]
                 rows = np.concatenate(parts, axis=0)
+                t_k0 = time.monotonic()
                 if kind == "leaf":
                     out = pred.predict_leaf_index(rows)
                 elif kind == "raw":
                     out = pred.predict_raw(rows)
                 else:
                     out = pred.predict(rows)
+                t_k1 = time.monotonic()
             except Exception as e:
                 # errors are counted per REQUEST by whoever consumes the
                 # futures (the HTTP handler) — counting the batch here
                 # too would double-book one failure
                 t_done = time.monotonic()
+                self._emit_trace(
+                    kind, batch, w_dispatch, t_done - t_dispatch,
+                    None, None,
+                    sum(r.shape[0] for r, _ in batch), "error")
                 for _, fut in batch:
                     fut.t_dispatch, fut.t_done = t_dispatch, t_done
                     fut.scored_by = pred
@@ -241,6 +286,9 @@ class MicroBatcher:
                 + EWMA_ALPHA * dt)
             if self.metrics is not None:
                 self.metrics.record_batch(rows.shape[0], len(batch))
+            self._emit_trace(kind, batch, w_dispatch, dt,
+                             t_k0 - t_dispatch, t_k1 - t_k0,
+                             rows.shape[0], "ok")
             s = 0
             for r, fut in batch:
                 fut.t_dispatch, fut.t_done = t_dispatch, t_done

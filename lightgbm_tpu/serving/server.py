@@ -69,6 +69,7 @@ from urllib.parse import parse_qs, urlsplit
 import numpy as np
 
 from ..io.parser import NA_VALUES
+from ..telemetry import disttrace
 from ..telemetry import prometheus
 from ..utils import faults
 from ..utils.log import Log
@@ -158,6 +159,11 @@ class ServingHandler(BaseHTTPRequestHandler):
         return rid or uuid.uuid4().hex[:16]
 
     def _reply(self, code, obj, headers=None):
+        root = getattr(self, "_trace_root", None)
+        if root is not None:
+            # every reply path funnels here: the root span's outcome
+            # tag (what tail sampling keys on) cannot be missed
+            root.set_tag("http.status", int(code))
         data = json.dumps(obj).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -313,6 +319,51 @@ class ServingHandler(BaseHTTPRequestHandler):
                 gauge.dec()
 
     def _handle_post(self):
+        """Trace shell around the predict path: opens the replica-side
+        root span (continuing the router's X-Trace-Ctx when present),
+        keeps it active for the handler thread so the batcher future
+        inherits it, and closes it with the reply's http.status. An
+        unhandled exception dumps the flight recorder first — the
+        blackbox is most valuable exactly when the handler dies."""
+        rec = getattr(self.server, "trace_recorder", None)
+        if rec is None or not rec.enabled:
+            self._serve_predict()
+            return
+        ctx = disttrace.parse_header(
+            self.headers.get(disttrace.TRACE_HEADER) or "")
+        root = rec.start("serve.request", ctx=ctx, kind="server",
+                         tags={"component": "serving",
+                               "path": self.path.split("?")[0]})
+        self._trace_root = root
+        t0 = time.monotonic()
+        try:
+            with disttrace.activate(root.context()):
+                self._serve_predict()
+        except Exception:
+            disttrace.FLIGHT.dump("unhandled_server_exception",
+                                  path=self.path.split("?")[0])
+            rec.finish(root, status="error",
+                       elapsed=time.monotonic() - t0)
+            self._trace_root = None
+            raise
+        code = root.tags.get("http.status")
+        rec.finish(root,
+                   status="error" if isinstance(code, int)
+                   and code >= 500 else "ok",
+                   elapsed=time.monotonic() - t0)
+        self._trace_root = None
+
+    def _trace_observe(self, name, start, duration_s, **tags):
+        """Synthesize a child span of this request's root from stamps
+        taken elsewhere (parse split, queue wait). No-op untraced."""
+        root = getattr(self, "_trace_root", None)
+        rec = getattr(self.server, "trace_recorder", None)
+        if root is None or rec is None:
+            return
+        rec.observe(name, root.context(), start, max(0.0, duration_s),
+                    tags=tags or None)
+
+    def _serve_predict(self):
         req_id = self._request_id()
         id_hdr = {"X-Request-Id": req_id}
         # drain the body BEFORE any reply: on an HTTP/1.1 keep-alive
@@ -340,6 +391,7 @@ class ServingHandler(BaseHTTPRequestHandler):
         # slow client upload must not pollute the /metricz percentiles
         # or fire slow_request alerts
         t0 = time.monotonic()
+        w0 = time.time()   # wall anchor for synthesized trace spans
         kind = {"/predict": "predict", "/predict_raw": "raw",
                 "/predict_leaf": "leaf"}.get(self.path.split("?")[0])
         if kind is None:
@@ -358,6 +410,8 @@ class ServingHandler(BaseHTTPRequestHandler):
             self._access_log(req_id, 0, 400, None)
             return
         t_parsed = time.monotonic()
+        self._trace_observe("serve.parse", w0, t_parsed - t0,
+                            rows=int(rows.shape[0]))
         srv = self.server
         # ---- chaos hooks (utils/faults serving faults; no-ops unless
         # a fault is armed globally or on this server's overrides dict)
@@ -386,16 +440,27 @@ class ServingHandler(BaseHTTPRequestHandler):
         admission = getattr(srv, "admission", None)
         deadline = None
         if admission is not None:
+            t_adm0 = time.monotonic()
             deadline = admission.deadline_from_header(
                 self.headers.get("X-Deadline-Ms"), now=t_parsed)
             if deadline is not None and deadline <= time.monotonic():
                 self.metrics.record_deadline_expired()
+                root = getattr(self, "_trace_root", None)
+                if root is not None:
+                    root.set_tag("decision", "deadline_expired")
                 self._reply(504, {"error": "deadline already expired",
                                   "request_id": req_id}, id_hdr)
                 self._access_log(req_id, rows.shape[0], 504, None)
                 return
             verdict, retry_after = admission.assess(deadline)
+            self._trace_observe(
+                "serve.admission", w0 + (t_adm0 - t0),
+                time.monotonic() - t_adm0, decision=verdict,
+                **admission.trace_tags())
             if verdict == "shed":
+                root = getattr(self, "_trace_root", None)
+                if root is not None:
+                    root.set_tag("decision", "shed")
                 headers = dict(id_hdr)
                 headers["Retry-After"] = str(
                     max(1, int(round(retry_after))))
@@ -413,6 +478,9 @@ class ServingHandler(BaseHTTPRequestHandler):
             # expired while queued: the batcher dropped it before any
             # device time was spent (504 — the client already moved on)
             self.metrics.record_deadline_expired()
+            root = getattr(self, "_trace_root", None)
+            if root is not None:
+                root.set_tag("decision", "expired_in_queue")
             self._reply(504, {"error": "deadline expired in queue",
                               "request_id": req_id}, id_hdr)
             self._access_log(req_id, rows.shape[0], 504, None)
@@ -435,6 +503,12 @@ class ServingHandler(BaseHTTPRequestHandler):
                 (fut.t_dispatch - fut.t_enqueue) * 1e3, 3)
             timing["compute_ms"] = round(
                 (fut.t_done - fut.t_dispatch) * 1e3, 3)
+            # queue = enqueue -> batch dispatch; the dispatch + kernel
+            # spans themselves come from the batcher worker (with links
+            # to every coalesced member)
+            self._trace_observe("serve.queue",
+                                w0 + (fut.t_enqueue - t0),
+                                fut.t_dispatch - fut.t_enqueue)
         self.metrics.record_request(rows.shape[0], latency)
         headers = dict(id_hdr)
         headers["X-Timing-Ms"] = ";".join(
@@ -605,12 +679,17 @@ def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
                 slow_request_ms=DEFAULT_SLOW_REQUEST_MS,
                 drift=None, skew=None, model_version=None,
                 monitor_settings=None, deadline_default_ms=0.0,
-                shed_queue_budget=1.0):
+                shed_queue_budget=1.0, trace_dir=None, trace_rank=0,
+                trace_sample_rate=disttrace.DEFAULT_SAMPLE_RATE,
+                trace_slow_only=False):
     """Wire predictor + batcher + metrics (+ optional drift/skew
     monitors, serving/drift.py) into a ThreadingHTTPServer (not yet
     serving — call serve_forever, or use it from tests).
     `monitor_settings` (the build_monitors kwargs) are remembered on
-    the server so a hot-swap can rebuild monitors for the new model."""
+    the server so a hot-swap can rebuild monitors for the new model.
+    `trace_dir` arms distributed tracing (telemetry/disttrace.py):
+    request spans journal there, tail-sampled, for the aggregator's
+    collector; the flight recorder registers this server's evidence."""
     metrics = ServingMetrics()
     batcher = MicroBatcher(predictor,
                            max_batch_rows=max_batch_rows,
@@ -622,6 +701,21 @@ def make_server(predictor, host="127.0.0.1", port=8099, max_wait_ms=2.0,
     srv = ServingHTTPServer((host, port), handler)
     srv.batcher = batcher
     srv.metrics = metrics
+    srv.trace_recorder = None
+    if trace_dir:
+        srv.trace_recorder = disttrace.TraceRecorder(
+            directory=trace_dir, rank=trace_rank, service="serving",
+            sample_rate=trace_sample_rate,
+            slow_ms=float(slow_request_ms or 0.0),
+            slow_only=trace_slow_only)
+        batcher.trace_recorder = srv.trace_recorder
+        # arm the blackbox beside the trace journal: on an unhandled
+        # handler exception / SIGQUIT the last seconds land on disk
+        disttrace.FLIGHT.configure(trace_dir, rank=trace_rank)
+        disttrace.FLIGHT.add_source(
+            "serving_metrics", lambda: metrics.snapshot())
+        disttrace.FLIGHT.add_source(
+            "trace_stats", srv.trace_recorder.stats)
     srv.model_version = model_version
     srv.swap_count = 0
     srv.inflight = _InflightGauge()
@@ -708,6 +802,28 @@ def main(argv=None):
                          "shed_queue_budget config knob)")
     ap.add_argument("--num-iteration", type=int, default=-1,
                     help="serve only the first N iterations of the model")
+    ap.add_argument("--trace-dir", default="",
+                    help="arm distributed tracing: journal tail-sampled "
+                         "trace records here for the aggregator's "
+                         "collector (telemetry/disttrace.py, "
+                         "docs/Observability.md)")
+    ap.add_argument("--trace-rank", type=int, default=0,
+                    help="journal rank suffix for this replica's trace "
+                         "records (keep distinct per process sharing "
+                         "--trace-dir)")
+    ap.add_argument("--trace-sample-rate", type=float, default=0.01,
+                    help="deterministic hash(trace_id) fraction of "
+                         "healthy traces to keep; error/slow traces "
+                         "are always kept (mirrors the "
+                         "trace_sample_rate config knob)")
+    ap.add_argument("--trace-slow-only", action="store_true",
+                    help="keep only error/slow traces, dropping even "
+                         "hash-sampled healthy ones (mirrors "
+                         "trace_slow_only)")
+    ap.add_argument("--no-blackbox", action="store_true",
+                    help="disable the crash flight recorder dump "
+                         "(blackbox-<rank>.json; mirrors the blackbox "
+                         "config knob)")
     from .drift import (DEFAULT_DRIFT_SAMPLE_RATE, DEFAULT_PSI_WARN,
                         DEFAULT_SKEW_SAMPLE_RATE, DEFAULT_SKEW_WARN)
     from ..io.profile import DEFAULT_PROFILE_BINS
@@ -784,7 +900,17 @@ def main(argv=None):
                       model_version=model_version,
                       monitor_settings=monitor_settings,
                       deadline_default_ms=args.deadline_default_ms,
-                      shed_queue_budget=args.shed_queue_budget)
+                      shed_queue_budget=args.shed_queue_budget,
+                      trace_dir=args.trace_dir or None,
+                      trace_rank=args.trace_rank,
+                      trace_sample_rate=args.trace_sample_rate,
+                      trace_slow_only=args.trace_slow_only)
+    if args.no_blackbox:
+        disttrace.FLIGHT.disarm()
+    elif args.trace_dir:
+        # SIGQUIT -> blackbox without killing the process: live
+        # inspection of a replica that looks wedged
+        disttrace.FLIGHT.install_sigquit()
     # the swap path re-applies this knob to every challenger
     # (fleet/hotswap.py HotSwapper)
     srv.num_iteration = args.num_iteration
@@ -831,6 +957,8 @@ def main(argv=None):
         serve_thread.join(timeout=10)
         srv.server_close()
         srv.batcher.close()
+        if srv.trace_recorder is not None:
+            srv.trace_recorder.close()
         Log.structured("Info", "drain", drained=bool(drained),
                        in_flight=srv.inflight.count,
                        queue_depth=srv.batcher.queue_depth())

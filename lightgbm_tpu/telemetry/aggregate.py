@@ -17,7 +17,12 @@ into a single merged snapshot served as
   each target's registry rendered with `rank`/`replica` + `role`
   labels (prometheus.render_multi keeps every family's TYPE line
   unique), fleet-level values as `fleet_*` gauges;
-- `/healthz` — aggregator liveness + per-target reachability.
+- `/healthz` — aggregator liveness + per-target reachability;
+- `/tracez` — with `--trace-dir`, the distributed-trace collector's
+  view: per-process `trace` journal records (telemetry/disttrace.py)
+  stitched into cross-process trees, error traces first then slowest,
+  each with a per-hop breakdown (router root -> attempt -> replica
+  parse/admission/queue -> batch dispatch -> kernel).
 
 Targets are `[role=]host:port` specs; `role` is `train`, `serve`,
 `router`, or `auto` (default — probe /trainz first, fall back to
@@ -41,6 +46,7 @@ rest of the telemetry package.
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -103,18 +109,125 @@ def _num(v, default=None):
         and not isinstance(v, bool) else default
 
 
+# ---------------------------------------------------------------- tracing
+def read_trace_records(directory):
+    """Every `trace` record from every rank journal under `directory`
+    (router, replicas and training ranks write to the SAME trace dir
+    with distinct ranks, so one read sees the whole fleet's spans)."""
+    from . import journal as journal_mod
+    records = []
+    for path in journal_mod.rank_files(directory):
+        recs, _bad = journal_mod.read_journal(path)
+        records.extend(r for r in recs if r.get("event") == "trace")
+    return records
+
+
+def _span_error(rec):
+    if rec.get("status") == "error":
+        return True
+    code = (rec.get("tags") or {}).get("http.status")
+    return isinstance(code, int) and code >= 400
+
+
+def stitch_traces(records):
+    """Group per-process `trace` records into cross-process trees.
+
+    Spans keyed by trace_id form the tree; a span carrying `links`
+    (the coalesced-batch spans from serving/batcher.py list every
+    OTHER member request's trace_id) is grafted into each linked tree
+    too, marked `shared` — so a member request's trace still shows the
+    batch-dispatch/kernel hop it rode even though the span was
+    journaled under the head request's trace_id. Returns trace
+    documents sorted error-first then slowest-first, each with a
+    per-hop breakdown ordered by wall start."""
+    by_trace = {}
+    for rec in records:
+        tid = rec.get("trace_id")
+        if tid and isinstance(rec.get("start"), (int, float)):
+            by_trace.setdefault(tid, []).append(rec)
+    for rec in records:
+        for linked in (rec.get("links") or ()):
+            if linked in by_trace and linked != rec.get("trace_id"):
+                by_trace[linked].append(dict(rec, shared=True))
+    traces = []
+    for tid, spans in by_trace.items():
+        spans.sort(key=lambda r: (r.get("start", 0.0),
+                                  r.get("span_id", "")))
+        t0 = min(s["start"] for s in spans)
+        t1 = max(s["start"] + float(s.get("duration_s") or 0.0)
+                 for s in spans)
+        ids = {s.get("span_id") for s in spans}
+        root = next((s for s in spans
+                     if not s.get("parent_span_id")
+                     or s.get("parent_span_id") not in ids), spans[0])
+        traces.append({
+            "trace_id": tid,
+            "start": round(t0, 6),
+            "duration_ms": round((t1 - t0) * 1e3, 3),
+            "status": ("error" if any(_span_error(s) for s in spans)
+                       else "ok"),
+            "root": root.get("name"),
+            "services": sorted({s.get("service") or "?"
+                                for s in spans}),
+            "span_count": len(spans),
+            "spans": [{
+                "name": s.get("name"),
+                "service": s.get("service") or "?",
+                "span_id": s.get("span_id"),
+                "parent_span_id": s.get("parent_span_id"),
+                "kind": s.get("kind", "internal"),
+                "offset_ms": round((s["start"] - t0) * 1e3, 3),
+                "duration_ms": round(
+                    float(s.get("duration_s") or 0.0) * 1e3, 3),
+                "status": s.get("status", "ok"),
+                **({"shared": True} if s.get("shared") else {}),
+                **({"tags": s["tags"]} if s.get("tags") else {}),
+            } for s in spans],
+        })
+    traces.sort(key=lambda t: (t["status"] != "error",
+                               -t["duration_ms"]))
+    return traces
+
+
+class TraceCollector:
+    """The /tracez backend: re-stitches the trace dir on demand (rank
+    journals are append-only JSONL; a full re-read per request is
+    cheap at journal scale and needs no offset bookkeeping), keeping
+    the `max_traces` most interesting trees (errors, then slowest)."""
+
+    def __init__(self, directory, max_traces=100):
+        self.directory = os.fspath(directory)
+        self.max_traces = int(max_traces)
+
+    def refresh(self):
+        return stitch_traces(read_trace_records(self.directory))
+
+    def tracez(self, n=None):
+        traces = self.refresh()
+        keep = self.max_traces if n is None else min(int(n),
+                                                     self.max_traces)
+        return {"trace_dir": self.directory,
+                "trace_count": len(traces),
+                "error_count": sum(1 for t in traces
+                                   if t["status"] == "error"),
+                "traces": traces[:keep]}
+
+
 class FleetAggregator:
     """Poll + merge (see module docstring). `poll_once` is synchronous
     (tests and --once call it directly); `start` runs it on a daemon
     thread every `poll_s` seconds."""
 
-    def __init__(self, targets, poll_s=2.0, timeout_s=5.0):
+    def __init__(self, targets, poll_s=2.0, timeout_s=5.0,
+                 trace_dir=None):
         self.targets = [t if isinstance(t, Target) else Target(t)
                         for t in targets]
         if not self.targets:
             raise ValueError("aggregator needs at least one target")
         self.poll_s = float(poll_s)
         self.timeout_s = float(timeout_s)
+        self.trace_collector = (TraceCollector(trace_dir)
+                                if trace_dir else None)
         self._lock = threading.Lock()
         self._state = {}          # host_port -> scrape doc
         self._polls = 0
@@ -302,6 +415,17 @@ class FleetAggregator:
                         self._send(200, json.dumps(
                             agg.snapshot(), default=str).encode(),
                             "application/json")
+                    elif path.startswith("/tracez"):
+                        if agg.trace_collector is None:
+                            self._send(404, json.dumps(
+                                {"error": "tracing not configured "
+                                          "(start with --trace-dir)"}
+                            ).encode(), "application/json")
+                        else:
+                            self._send(200, json.dumps(
+                                agg.trace_collector.tracez(),
+                                default=str).encode(),
+                                "application/json")
                     else:
                         self._send(404, json.dumps(
                             {"error": f"unknown path {self.path}"}
@@ -445,6 +569,10 @@ def main(argv=None):
     ap.add_argument("--timeout-s", type=float, default=5.0)
     ap.add_argument("--once", action="store_true",
                     help="poll once, print the merged JSON, exit")
+    ap.add_argument("--trace-dir", default="",
+                    help="telemetry dir the fleet's trace journals "
+                         "land in; enables /tracez (stitched "
+                         "cross-process request traces)")
     args = ap.parse_args(argv)
     if args.port is None:
         # the `aggregate_port` knob is the documented default for this
@@ -454,7 +582,8 @@ def main(argv=None):
         args.port = int(Config().aggregate_port)
     try:
         agg = FleetAggregator(args.targets, poll_s=args.poll_s,
-                              timeout_s=args.timeout_s)
+                              timeout_s=args.timeout_s,
+                              trace_dir=args.trace_dir or None)
     except ValueError as e:
         print(f"aggregate: {e}", file=sys.stderr)
         return 2

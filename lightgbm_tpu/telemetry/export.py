@@ -45,6 +45,7 @@ from . import journal as journal_mod
 TID_TRAIN = 0
 TID_SUPERVISOR = 1
 TID_COMM = 2         # collective wait slices (`comm` records)
+TID_TRACE = 3        # distributed-request spans (`trace` records)
 TID_SPAN_BASE = 16   # span recording threads map to 16, 17, ...
 
 _INSTANT_EVENTS = {"run_start", "run_end", "resume", "truncate",
@@ -164,10 +165,17 @@ def build_trace(records):
                       if isinstance(s, dict)]
             if starts:
                 t0 = min(t0, _num(rec.get("epoch_ts"), t0) + min(starts))
+        elif event == "trace":
+            # request spans carry their own wall start, earlier than
+            # the journal ts the fragment was flushed at
+            t0 = min(t0, _num(rec.get("start"), rec["ts"]))
     b = _TraceBuilder(t0)
     # (iteration, collective) -> [(rank, anchor_ts_us)] for the
     # cross-rank flow pass below
     comm_anchors = {}
+    # trace_id -> [(anchor_ts_us, rank)] for the cross-process
+    # request-flow pass (router track -> replica track arrows)
+    trace_anchors = {}
 
     for rec in records:
         event = rec.get("event")
@@ -259,6 +267,27 @@ def build_trace(records):
             b.slice(rank, tid, f"compile {label}", ts - dur,
                     max(dur, 1e-6),
                     {"cache_hit": bool(rec.get("cache_hit"))})
+        elif event == "trace":
+            # one distributed-request span per record on the rank's
+            # `requests` lane; the trace_id groups them and the flow
+            # pass below draws the cross-process arrows
+            trace_id = rec.get("trace_id")
+            if not isinstance(trace_id, str) or not trace_id:
+                continue
+            b._ensure_thread(rank, TID_TRACE, "requests")
+            dur = max(_num(rec.get("duration_s")), 1e-6)
+            start = _num(rec.get("start"), ts)
+            args = {"trace_id": trace_id,
+                    "span_id": rec.get("span_id", ""),
+                    "status": rec.get("status", "ok"),
+                    "service": rec.get("service", "")}
+            tags = rec.get("tags")
+            if isinstance(tags, dict) and tags:
+                args["tags"] = tags
+            b.slice(rank, TID_TRACE, rec.get("name", "span"),
+                    start, dur, args)
+            trace_anchors.setdefault(trace_id, []).append(
+                (b._us(start + dur / 2.0), rank))
         elif event == "spans":
             epoch = _num(rec.get("epoch_ts"), ts)
             for span in rec.get("spans") or []:
@@ -311,6 +340,24 @@ def build_trace(records):
                   "tid": TID_COMM, "ts": ts_us}
             if ph == "f":
                 ev["bp"] = "e"   # bind to the enclosing slice
+            b.events.append(ev)
+
+    # cross-process request flows: one arrow chain per trace_id whose
+    # spans landed on >= 2 process tracks (router pid -> replica pid).
+    # String flow ids ("trace:<id>") keep the namespace disjoint from
+    # the integer comm-flow ids above; same one-`s`-one-`f` rule
+    for trace_id, anchors in sorted(trace_anchors.items()):
+        anchors = sorted(set(anchors))
+        if len({r for _, r in anchors}) < 2:
+            continue
+        last = len(anchors) - 1
+        for idx, (ts_us, rank) in enumerate(anchors):
+            ph = "s" if idx == 0 else ("f" if idx == last else "t")
+            ev = {"name": f"request {trace_id[:8]}", "ph": ph,
+                  "cat": "trace_flow", "id": f"trace:{trace_id}",
+                  "pid": rank, "tid": TID_TRACE, "ts": ts_us}
+            if ph == "f":
+                ev["bp"] = "e"
             b.events.append(ev)
 
     # stable nesting: same-timestamp slices sort longest-first so

@@ -193,6 +193,19 @@ SCHEMA = {
     # the Span.as_dict() list the trace exporter turns into slices
     "spans": {"required": {"epoch_ts": float, "spans": list},
               "optional": {"source": str}},
+    # one completed distributed-trace span (telemetry/disttrace.py):
+    # start is wall epoch seconds (cross-process comparable), links
+    # lists other trace_ids a batch span coalesced (the collector
+    # follows them when stitching), flags carries the propagated
+    # head-sampling bit. The aggregator's TraceCollector stitches
+    # these per-process fragments into /tracez trees
+    "trace": {"required": {"trace_id": str, "span_id": str,
+                           "name": str, "start": float,
+                           "duration_s": float},
+              "optional": {"parent_span_id": str, "kind": str,
+                           "status": str, "flags": int, "tags": dict,
+                           "links": list, "service": str,
+                           "source": str}},
     "note": {"required": {}, "optional": {"msg": str, "source": str}},
 }
 

@@ -31,6 +31,8 @@ import threading
 import time
 from collections import defaultdict, deque
 
+from . import disttrace
+
 RECENT_SPANS = 256
 
 
@@ -151,6 +153,18 @@ class SpanTracer:
             self._recent.append(Span(name, path, t0 - self._epoch,
                                      elapsed, tags,
                                      tid=threading.get_ident()))
+        # distributed-trace mirror: when this thread runs under an
+        # active X-Trace-Ctx (a traced canary retrain, a request that
+        # reached training code), the span ALSO lands on that trace so
+        # /tracez shows training phases inside the cross-process tree.
+        # One thread-local read when no context is active
+        ctx = disttrace.current()
+        if ctx is not None:
+            rec = disttrace.get_recorder()
+            if rec.enabled:
+                rec.observe("train." + name, ctx,
+                            time.time() - elapsed, elapsed,
+                            tags=dict(tags) if tags else None)
 
     def add(self, name, seconds):
         """Accumulate an externally-timed phase (e.g. the bench's

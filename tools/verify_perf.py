@@ -633,6 +633,68 @@ def check_router():
     return ok
 
 
+def check_trace():
+    """Distributed-tracing overhead guard (`make verify-obs`; bench
+    trace_probe in gate form, docs/Observability.md): two identical
+    serving replicas — tracing off vs the full trace pipeline at the
+    default sample rate — take interleaved single-row traffic; the
+    traced arm's p99 must stay within VERIFY_TRACE_OVERHEAD_PCT
+    (default 1%) of the untraced arm's, with VERIFY_TRACE_SLACK_MS
+    (default 0.5 ms) of absolute slack so scheduler jitter on the
+    1-core CI rung can't fail a sub-0.1 ms delta. The traced arm must
+    also have RECORDED spans — an accidentally-dead recorder would
+    gate 0% forever."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import bench
+    res = bench.trace_probe(
+        timeout_s=int(os.environ.get("VERIFY_TRACE_TIMEOUT", "300")))
+    if "error" in res:
+        print(f"verify-trace: probe failed: {res['error']}")
+        return False
+    ok = True
+    print(f"verify-trace: {res['samples_per_arm']} samples/arm, "
+          f"p99 off {res['p99_off_ms']:.3f} ms vs on "
+          f"{res['p99_on_ms']:.3f} ms (sample rate "
+          f"{res['sample_rate']})")
+    min_samples = int(os.environ.get("VERIFY_TRACE_MIN_SAMPLES", "200"))
+    if res["samples_per_arm"] < min_samples:
+        print(f"verify-trace: only {res['samples_per_arm']} sample(s) "
+              f"per arm (floor {min_samples}) -> INSUFFICIENT SAMPLES")
+        ok = False
+    if res.get("traces_seen", 0) < 1:
+        print("verify-trace: traced arm saw zero traces — the "
+              "overhead gate is vacuous -> RECORDER DEAD")
+        ok = False
+    else:
+        print(f"verify-trace: traced arm saw {res['traces_seen']} "
+              f"trace(s), journaled {res['trace_spans_recorded']} "
+              "span(s) -> OK")
+    pct = float(os.environ.get("VERIFY_TRACE_OVERHEAD_PCT", "1.0"))
+    slack_ms = float(os.environ.get("VERIFY_TRACE_SLACK_MS", "0.5"))
+    # the gated statistic is the median-over-rounds p99 delta (robust
+    # to a scheduler hiccup landing in one arm's window; the pooled
+    # delta is reported alongside) — see bench.trace_probe
+    delta = res.get("p99_delta_median_ms",
+                    res["p99_on_ms"] - res["p99_off_ms"])
+    limit = max(res["p99_off_ms"] * pct / 100.0, slack_ms)
+    pooled = res["p99_on_ms"] - res["p99_off_ms"]
+    if delta > limit:
+        print(f"verify-trace: median per-round p99 overhead "
+              f"{delta:.3f} ms (pooled {pooled:+.3f} ms / "
+              f"{res['overhead_pct']:+.2f}%) > limit {limit:.3f} ms "
+              f"(max of {pct:.1f}% and {slack_ms:.2f} ms noise slack) "
+              "-> TRACING COSTS THE LATENCY ENVELOPE")
+        ok = False
+    else:
+        print(f"verify-trace: median per-round p99 overhead "
+              f"{delta:+.3f} ms (pooled {pooled:+.3f} ms / "
+              f"{res['overhead_pct']:+.2f}%) within limit "
+              f"{limit:.3f} ms -> OK")
+    return ok
+
+
 def check_linear():
     """Linear-leaf acceptance guard (`make verify-linear`; bench
     linear_probe in gate form, docs/Linear-Trees.md): (1) the sample-
@@ -717,6 +779,12 @@ def check_linear():
 
 
 def main():
+    if "--trace" in sys.argv:
+        if not check_trace():
+            print("verify-trace: FAILED")
+            return 1
+        print("verify-trace: all checks passed")
+        return 0
     if "--linear" in sys.argv:
         if not check_linear():
             print("verify-linear: FAILED")
